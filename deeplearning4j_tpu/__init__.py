@@ -1,0 +1,24 @@
+"""deeplearning4j-tpu: a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of
+deeplearning4j (v0.4-rc3.9 era): layer/network abstractions, a config DSL with
+JSON round-trip, optimizers, evaluation, data pipeline, NLP/embedding models,
+clustering/t-SNE, and distributed training — rebuilt TPU-first.
+
+Where the reference dispatches every INDArray op synchronously to an external
+native backend (ND4J; see /root/reference SURVEY), this framework compiles the
+entire training step (forward + backward + updater) to a single XLA program via
+``jax.jit`` / ``pjit``, shards over ``jax.sharding.Mesh`` for data/tensor/
+sequence parallelism, and keeps the host side (ETL, checkpoints, CLI, UI) in
+Python/C++.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

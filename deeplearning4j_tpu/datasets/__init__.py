@@ -1,0 +1,10 @@
+"""Host-side data pipeline (the reference's ``datasets/`` + Canova bridge)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)

@@ -1,0 +1,128 @@
+"""DataSet / MultiDataSet: host-side batch containers.
+
+Equivalent of nd4j's ``DataSet``/``MultiDataSet`` (128/21 import sites in the
+reference — SURVEY §2.2): features + labels (+ per-example or per-timestep
+masks for variable-length series). Arrays are host numpy; transfer to device
+HBM happens at the jit boundary (or ahead of time via the async iterator's
+prefetch, the ``AsyncDataSetIterator`` role).
+
+Layouts: FF [b, f]; RNN [b, t, f] (batch-major, time second); CNN NHWC
+[b, h, w, c].
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels=None, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    # --- reference API surface ---
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def slice_time(self, start: int, end: int) -> "DataSet":
+        """Time-axis slice for TBPTT (features/labels [b, t, ...])."""
+        f = self.features[:, start:end]
+        l = self.labels[:, start:end] if self.labels is not None and self.labels.ndim == 3 else self.labels
+        fm = self.features_mask[:, start:end] if self.features_mask is not None else None
+        lm = self.labels_mask[:, start:end] if self.labels_mask is not None else None
+        return DataSet(f, l, fm, lm)
+
+    def sample(self, n: int, rng: Optional[np.random.Generator] = None) -> "DataSet":
+        rng = rng or np.random.default_rng()
+        idx = rng.choice(self.num_examples(), size=n, replace=n > self.num_examples())
+        return self._take(idx)
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        return self._take(np.arange(n_train)), self._take(
+            np.arange(n_train, self.num_examples()))
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        return [
+            self._take(np.arange(i, min(i + batch_size, self.num_examples())))
+            for i in range(0, self.num_examples(), batch_size)
+        ]
+
+    def _take(self, idx) -> "DataSet":
+        return DataSet(
+            self.features[idx],
+            None if self.labels is None else self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx],
+        )
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            None if datasets[0].labels is None else np.concatenate([d.labels for d in datasets]),
+            None if datasets[0].features_mask is None else np.concatenate([d.features_mask for d in datasets]),
+            None if datasets[0].labels_mask is None else np.concatenate([d.labels_mask for d in datasets]),
+        )
+
+    def scale_minus_one_to_one(self):
+        lo, hi = self.features.min(), self.features.max()
+        self.features = 2.0 * (self.features - lo) / max(hi - lo, 1e-12) - 1.0
+
+    def normalize_zero_mean_unit_variance(self):
+        mean = self.features.mean(axis=0, keepdims=True)
+        std = self.features.std(axis=0, keepdims=True) + 1e-12
+        self.features = (self.features - mean) / std
+
+    def __repr__(self):
+        return (f"DataSet(features={self.features.shape}, "
+                f"labels={None if self.labels is None else self.labels.shape})")
+
+
+class MultiDataSet:
+    """Multiple named/ordered inputs + outputs (ComputationGraph batches)."""
+
+    def __init__(self, features: Sequence, labels: Sequence,
+                 features_masks: Optional[Sequence] = None,
+                 labels_masks: Optional[Sequence] = None):
+        self.features = [np.asarray(f) for f in features]
+        self.labels = [np.asarray(l) for l in labels]
+        self.features_masks = (
+            None if features_masks is None
+            else [None if m is None else np.asarray(m) for m in features_masks]
+        )
+        self.labels_masks = (
+            None if labels_masks is None
+            else [None if m is None else np.asarray(m) for m in labels_masks]
+        )
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    @staticmethod
+    def from_dataset(ds: DataSet) -> "MultiDataSet":
+        return MultiDataSet(
+            [ds.features], [ds.labels],
+            None if ds.features_mask is None else [ds.features_mask],
+            None if ds.labels_mask is None else [ds.labels_mask],
+        )

@@ -1,0 +1,286 @@
+"""DataSetIterator protocol + wrappers.
+
+Mirror of ``datasets/iterator/`` (DataSetIterator.java:53,
+BaseDatasetIterator, AsyncDataSetIterator.java:44 background-prefetch,
+MultipleEpochsIterator, SamplingDataSetIterator, ListDataSetIterator).
+
+``AsyncDataSetIterator`` keeps the reference's role — overlap host batch
+prep with device compute — using a daemon thread + bounded queue; combined
+with the jitted train step's async dispatch this double-buffers host→HBM
+transfers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterable of DataSet minibatches with reset semantics."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    # --- protocol ---
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+    def input_columns(self) -> int:
+        raise NotImplementedError
+
+    def total_outcomes(self) -> int:
+        raise NotImplementedError
+
+
+class BaseDataSetIterator(DataSetIterator):
+    """Iterator over an in-memory fetcher (BaseDatasetIterator.java)."""
+
+    def __init__(self, batch_size: int, num_examples: int, fetcher):
+        self.batch_size = int(batch_size)
+        self.num_examples_ = int(num_examples)
+        self.fetcher = fetcher
+        self.cursor = 0
+
+    def has_next(self) -> bool:
+        return self.cursor < self.num_examples_
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = min(num or self.batch_size, self.num_examples_ - self.cursor)
+        ds = self.fetcher.fetch(self.cursor, n)
+        self.cursor += n
+        return ds
+
+    def reset(self) -> None:
+        self.cursor = 0
+        if hasattr(self.fetcher, "reset"):
+            self.fetcher.reset()
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return self.num_examples_
+
+    def input_columns(self) -> int:
+        return self.fetcher.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.fetcher.total_outcomes()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterator over a list of examples, re-batched (ListDataSetIterator)."""
+
+    def __init__(self, dataset_or_list, batch_size: int = 10):
+        if isinstance(dataset_or_list, DataSet):
+            self._batches = dataset_or_list.batch_by(batch_size)
+        else:
+            merged = DataSet.merge(list(dataset_or_list))
+            self._batches = merged.batch_by(batch_size)
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._batches)
+
+    def next(self, num=None):
+        ds = self._batches[self._pos]
+        self._pos += 1
+        return ds
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self.batch_size
+
+    def total_examples(self):
+        return sum(b.num_examples() for b in self._batches)
+
+    def input_columns(self):
+        return int(self._batches[0].features.shape[-1])
+
+    def total_outcomes(self):
+        return int(self._batches[0].labels.shape[-1])
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Loops an underlying iterator N times (MultipleEpochsIterator.java)."""
+
+    def __init__(self, num_epochs: int, underlying: DataSetIterator):
+        self.num_epochs = int(num_epochs)
+        self.underlying = underlying
+        self.epoch = 0
+
+    def has_next(self):
+        if self.underlying.has_next():
+            return True
+        if self.epoch + 1 < self.num_epochs:
+            self.epoch += 1
+            self.underlying.reset()
+            return self.underlying.has_next()
+        return False
+
+    def next(self, num=None):
+        return self.underlying.next(num)
+
+    def reset(self):
+        self.epoch = 0
+        self.underlying.reset()
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def total_examples(self):
+        return self.underlying.total_examples() * self.num_epochs
+
+    def input_columns(self):
+        return self.underlying.input_columns()
+
+    def total_outcomes(self):
+        return self.underlying.total_outcomes()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random-with-replacement sampling batches (SamplingDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, total_batches: int,
+                 seed: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.total_batches = total_batches
+        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self.total_batches
+
+    def next(self, num=None):
+        self._count += 1
+        return self.dataset.sample(num or self.batch_size, self._rng)
+
+    def reset(self):
+        self._count = 0
+        self._rng = np.random.default_rng(self._seed)
+
+    def batch(self):
+        return self.batch_size
+
+    def total_examples(self):
+        return self.batch_size * self.total_batches
+
+    def input_columns(self):
+        return int(self.dataset.features.shape[-1])
+
+    def total_outcomes(self):
+        return int(self.dataset.labels.shape[-1])
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (AsyncDataSetIterator.java:44).
+
+    A daemon producer thread drains the underlying iterator into a bounded
+    queue (the reference's LinkedBlockingDeque) so host ETL overlaps device
+    compute. ``reset`` drains + restarts the producer, mirroring the
+    reference's guarded reset semantics (:77-90).
+    """
+
+    _END = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 4):
+        self.underlying = underlying
+        self.queue_size = queue_size
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None
+        self._started = False
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._stop_flag = threading.Event()
+        self._producer_error: Optional[BaseException] = None
+        stop = self._stop_flag
+
+        def producer():
+            try:
+                while self.underlying.has_next():
+                    if stop.is_set():
+                        return
+                    item = self.underlying.next()
+                    while not stop.is_set():
+                        try:
+                            self._queue.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as exc:  # re-raised on the consumer side
+                self._producer_error = exc
+            finally:
+                if not stop.is_set():
+                    self._queue.put(self._END)
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+        self._started = True
+
+    def has_next(self):
+        if self._peek is not None:
+            return self._peek is not self._END
+        if not self._started:
+            self._start()
+        self._peek = self._queue.get()
+        if self._peek is self._END and self._producer_error is not None:
+            exc, self._producer_error = self._producer_error, None
+            raise exc
+        return self._peek is not self._END
+
+    def next(self, num=None):
+        if not self.has_next():
+            raise StopIteration
+        item, self._peek = self._peek, None
+        return item
+
+    def reset(self):
+        if self._started and self._thread is not None and self._thread.is_alive():
+            self._stop_flag.set()
+            self._thread.join(timeout=5)
+        self.underlying.reset()
+        self._peek = None
+        self._started = False
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def total_examples(self):
+        return self.underlying.total_examples()
+
+    def input_columns(self):
+        return self.underlying.input_columns()
+
+    def total_outcomes(self):
+        return self.underlying.total_outcomes()

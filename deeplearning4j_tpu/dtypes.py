@@ -1,0 +1,80 @@
+"""Dtype policy: parameters vs compute vs output dtypes.
+
+Replaces the reference's single global buffer dtype
+(``DataBuffer``/``DataTypeUtil`` — /root/reference SURVEY §2.2: float/double
+global switch) with a TPU-appropriate mixed-precision policy: parameters kept
+in float32, compute optionally in bfloat16 so matmuls/convs hit the MXU at
+full rate, outputs/losses accumulated in float32.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    """Immutable dtype policy triple."""
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_output(self, x):
+        return jnp.asarray(x, self.output_dtype)
+
+    def cast_param(self, x):
+        return jnp.asarray(x, self.param_dtype)
+
+
+FLOAT32 = DtypePolicy(jnp.float32, jnp.float32, jnp.float32)
+# MXU-friendly: bf16 matmul inputs, f32 params/accumulation.
+MIXED_BF16 = DtypePolicy(jnp.float32, jnp.bfloat16, jnp.float32)
+# Double precision — used by gradient checks, mirroring the reference's
+# requirement that gradient checks run in double (SURVEY §4).
+FLOAT64 = DtypePolicy(jnp.float64, jnp.float64, jnp.float64)
+
+_default_policy: DtypePolicy = FLOAT32
+
+
+def get_policy() -> DtypePolicy:
+    return _default_policy
+
+
+def set_policy(policy: DtypePolicy) -> None:
+    global _default_policy
+    _default_policy = policy
+
+
+@contextlib.contextmanager
+def policy_scope(policy: DtypePolicy) -> Iterator[DtypePolicy]:
+    """Temporarily override the global dtype policy."""
+    global _default_policy
+    prev = _default_policy
+    _default_policy = policy
+    try:
+        yield policy
+    finally:
+        _default_policy = prev
+
+
+def policy_from_name(name: str) -> DtypePolicy:
+    table = {
+        "float32": FLOAT32,
+        "f32": FLOAT32,
+        "mixed_bfloat16": MIXED_BF16,
+        "bf16": MIXED_BF16,
+        "float64": FLOAT64,
+        "f64": FLOAT64,
+    }
+    key = name.lower()
+    if key not in table:
+        raise ValueError(f"unknown dtype policy {name!r}; one of {sorted(table)}")
+    return table[key]

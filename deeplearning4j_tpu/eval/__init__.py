@@ -1,0 +1,13 @@
+"""Evaluation: classification + regression metrics.
+
+Mirror of ``eval/Evaluation.java`` (771 LoC: accuracy/precision/recall/F1 via
+ConfusionMatrix, eval(INDArray,INDArray) :90-147, stats() text report,
+merge :684 for distributed map-side eval) and RegressionEvaluation.java
+(MSE/MAE/RMSE/R²/correlation per column).
+"""
+
+from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
+    ConfusionMatrix,
+    Evaluation,
+    RegressionEvaluation,
+)

@@ -1,0 +1,201 @@
+"""Input preprocessors: rank adapters between layer families.
+
+Mirror of ``nn/conf/preprocessor/`` (CnnToFeedForward, FeedForwardToCnn,
+RnnToFeedForward, FeedForwardToRnn, CnnToRnn, RnnToCnn, Reshape,
+ZeroMeanAndUnitVariance, UnitVariance, BinomialSampling, Composable — SURVEY
+§2.3). Each reference preprocessor carries a hand-written ``backprop``; here
+they are pure reshapes/normalisations inside the jitted forward, so
+``jax.grad`` derives the backward pass.
+
+Layout note: this framework is NHWC ([batch, height, width, channels]) —
+the TPU-native layout — whereas the reference is NCHW. Flattening order
+therefore differs from the reference's c-order flatten; the config DSL is
+layout-agnostic (sizes only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Type
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+_PREPROC_REGISTRY: Dict[str, Type["InputPreProcessor"]] = {}
+
+
+def register_preprocessor(cls):
+    _PREPROC_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class InputPreProcessor:
+    def pre_process(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__}
+        d.update({f.name: getattr(self, f.name) for f in dataclasses.fields(self)
+                  if getattr(self, f.name) is not None})
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputPreProcessor":
+        d = dict(d)
+        cls = _PREPROC_REGISTRY[d.pop("type")]
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: (tuple(v) if isinstance(v, list) else v)
+                      for k, v in d.items() if k in names})
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, h, w, c] → [b, h*w*c] (reference: CnnToFeedForwardPreProcessor)."""
+
+    height: Optional[int] = None
+    width: Optional[int] = None
+    channels: Optional[int] = None
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(input_type.flat_size())
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[b, h*w*c] → [b, h, w, c]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def pre_process(self, x):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, t, f] → [b*t, f] (time folded into batch, as the reference does)."""
+
+    def pre_process(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[b*t, f] → [b, t, f]; needs the time length at apply time, so the
+    network threads the current minibatch/time shape in."""
+
+    def pre_process(self, x, batch: Optional[int] = None):
+        if x.ndim == 3:
+            return x
+        assert batch is not None, "FeedForwardToRnn needs batch size"
+        return x.reshape(batch, -1, x.shape[-1])
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[b*t, h, w, c] → [b, t, h*w*c]."""
+
+    height: Optional[int] = None
+    width: Optional[int] = None
+    channels: Optional[int] = None
+
+    def pre_process(self, x, batch: Optional[int] = None):
+        assert batch is not None
+        return x.reshape(batch, -1, x.shape[1] * x.shape[2] * x.shape[3])
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[b, t, h*w*c] → [b*t, h, w, c]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def pre_process(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class ReshapePreProcessor(InputPreProcessor):
+    """Arbitrary reshape keeping batch dim (reference ReshapePreProcessor)."""
+
+    shape: tuple = ()
+
+    def pre_process(self, x):
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def output_type(self, input_type):
+        size = 1
+        for s in self.shape:
+            size *= s
+        return InputType.feed_forward(size)
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    """Per-example standardisation (reference ZeroMeanAndUnitVariance)."""
+
+    def pre_process(self, x):
+        mean = jnp.mean(x, axis=tuple(range(1, x.ndim)), keepdims=True)
+        std = jnp.std(x, axis=tuple(range(1, x.ndim)), keepdims=True)
+        return (x - mean) / (std + 1e-8)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class UnitVariancePreProcessor(InputPreProcessor):
+    def pre_process(self, x):
+        std = jnp.std(x, axis=tuple(range(1, x.ndim)), keepdims=True)
+        return x / (std + 1e-8)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    def pre_process(self, x):
+        mean = jnp.mean(x, axis=tuple(range(1, x.ndim)), keepdims=True)
+        return x - mean
+
+    def output_type(self, input_type):
+        return input_type

@@ -1,0 +1,371 @@
+"""ComputationGraph: DAG networks with multi-input/multi-output.
+
+Functional re-design of ``nn/graph/ComputationGraph.java:68`` (init :214,
+topological order :342,606, fit :449-563, computeGradientAndScore :668,
+feedForward :701-729) and the vertex impls in ``nn/graph/vertex/impl/``
+(LayerVertex, MergeVertex, ElementWiseVertex, SubsetVertex,
+LastTimeStepVertex, DuplicateToTimeSeriesVertex).
+
+The whole DAG forward + every output head's loss + backward + updaters
+compile into ONE XLA program; vertex dispatch happens at trace time (the
+topo order is static), so at runtime there is no graph interpreter at all —
+unlike the reference, which walks GraphVertex[] per minibatch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes as dtypes_mod
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    GraphVertexConf,
+    LastTimeStepVertex,
+    MergeVertex,
+    PreprocessorVertex,
+    ScaleVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToRnnPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    InputPreProcessor,
+)
+from deeplearning4j_tpu.nn.layers.base import get_layer_impl
+from deeplearning4j_tpu.nn.updater import (
+    UpdaterSpec,
+    apply_updater,
+    init_updater_state,
+    lr_policy_scale,
+)
+from deeplearning4j_tpu.ops.losses import compute_loss
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.layer_impls = {n: get_layer_impl(lc) for n, lc in conf.layers.items()}
+        self.params: Dict[str, Any] = {}
+        self.net_state: Dict[str, Any] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.updater_specs: Dict[str, UpdaterSpec] = {}
+        self.iteration_count = 0
+        self.score_value = float("nan")
+        self.listeners: List[Any] = []
+        self._initialized = False
+        self._rng = jax.random.PRNGKey(conf.global_conf.seed)
+        self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
+
+    # ------------------------------------------------------------------
+    def init(self) -> "ComputationGraph":
+        if self._initialized:
+            return self
+        gc = self.conf.global_conf
+        key = jax.random.PRNGKey(gc.seed)
+        with dtypes_mod.policy_scope(self._policy):
+            for name in sorted(self.layer_impls):
+                key, sub = jax.random.split(key)
+                impl = self.layer_impls[name]
+                self.params[name] = impl.init_params(sub)
+                self.net_state[name] = impl.init_state()
+        self.updater_specs = {
+            n: UpdaterSpec.from_layer_conf(lc, gc.learning_rate)
+            for n, lc in self.conf.layers.items()
+        }
+        self.updater_state = {
+            n: init_updater_state(spec, self.params[n])
+            for n, spec in self.updater_specs.items()
+        }
+        self._initialized = True
+        return self
+
+    def _ensure_init(self):
+        if not self._initialized:
+            self.init()
+
+    # ------------------------------------------------------------------
+    # forward over topo order (pure)
+    # ------------------------------------------------------------------
+    def _forward(self, params, net_state, inputs: Sequence[jnp.ndarray], *,
+                 train: bool, rng, feature_masks: Optional[Sequence] = None,
+                 collect: bool = False):
+        conf = self.conf
+        values: Dict[str, jnp.ndarray] = {}
+        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        for i, name in enumerate(conf.inputs):
+            values[name] = inputs[i]
+            masks[name] = None if feature_masks is None else feature_masks[i]
+        new_net_state: Dict[str, Any] = {}
+        for name in conf.topological_order:
+            if name in conf.inputs:
+                continue
+            in_names = conf.vertex_inputs[name]
+            in_vals = [values[n] for n in in_names]
+            in_mask = next((masks.get(n) for n in in_names
+                            if masks.get(n) is not None), None)
+            if name in conf.layers:
+                impl = self.layer_impls[name]
+                h = in_vals[0]
+                batch = h.shape[0]
+                pre = conf.preprocessors.get(name)
+                if pre is not None:
+                    if isinstance(pre, (FeedForwardToRnnPreProcessor,
+                                        CnnToRnnPreProcessor)):
+                        h = pre.pre_process(h, batch=batch)
+                    else:
+                        h = pre.pre_process(h)
+                sub_rng = None
+                if rng is not None:
+                    rng, sub_rng = jax.random.split(rng)
+                mask = in_mask if h.ndim == 3 else None
+                h, lstate = impl.forward(
+                    params[name], h, dict(net_state.get(name, {})),
+                    train=train, rng=sub_rng, mask=mask)
+                new_net_state[name] = {
+                    k: v for k, v in lstate.items()
+                    if k in net_state.get(name, {})
+                }
+                values[name] = h
+                masks[name] = in_mask
+            else:
+                values[name] = self._apply_vertex(
+                    conf.vertices[name], in_vals, in_names, values, masks)
+                masks[name] = in_mask
+        if collect:
+            return values, new_net_state
+        return [values[o] for o in conf.outputs], new_net_state
+
+    def _apply_vertex(self, vertex: GraphVertexConf, in_vals, in_names,
+                      values, masks):
+        if isinstance(vertex, MergeVertex):
+            return jnp.concatenate(in_vals, axis=-1)
+        if isinstance(vertex, ElementWiseVertex):
+            op = vertex.op
+            out = in_vals[0]
+            for v in in_vals[1:]:
+                if op == "Add":
+                    out = out + v
+                elif op == "Subtract":
+                    out = out - v
+                elif op == "Product":
+                    out = out * v
+                elif op == "Max":
+                    out = jnp.maximum(out, v)
+                elif op == "Average":
+                    out = out + v
+                else:
+                    raise ValueError(f"unknown elementwise op {op}")
+            if op == "Average":
+                out = out / float(len(in_vals))
+            return out
+        if isinstance(vertex, SubsetVertex):
+            return in_vals[0][..., vertex.from_index:vertex.to_index + 1]
+        if isinstance(vertex, LastTimeStepVertex):
+            x = in_vals[0]  # [b, t, f]
+            mask = None
+            if vertex.mask_input is not None:
+                mask = masks.get(vertex.mask_input)
+            if mask is None:
+                return x[:, -1, :]
+            # last non-masked step per example
+            idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+        if isinstance(vertex, DuplicateToTimeSeriesVertex):
+            x = in_vals[0]  # [b, f]
+            ref = values[vertex.input_name]
+            t = ref.shape[1]
+            return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1]))
+        if isinstance(vertex, ScaleVertex):
+            return in_vals[0] * vertex.scale
+        if isinstance(vertex, StackVertex):
+            return jnp.concatenate(in_vals, axis=0)
+        if isinstance(vertex, UnstackVertex):
+            x = in_vals[0]
+            n = x.shape[0] // vertex.stack_size
+            return x[vertex.from_index * n:(vertex.from_index + 1) * n]
+        if isinstance(vertex, PreprocessorVertex):
+            p = InputPreProcessor.from_dict(vertex.preprocessor)
+            return p.pre_process(in_vals[0])
+        raise ValueError(f"unknown vertex {type(vertex).__name__}")
+
+    # ------------------------------------------------------------------
+    # loss over all output heads
+    # ------------------------------------------------------------------
+    def _loss_and_state(self, params, net_state, inputs, labels,
+                        feature_masks, label_masks, rng, train: bool):
+        outs, new_state = self._forward(
+            params, net_state, inputs, train=train, rng=rng,
+            feature_masks=feature_masks)
+        total = 0.0
+        for i, out_name in enumerate(self.conf.outputs):
+            lc = self.conf.layers.get(out_name)
+            if lc is None or not hasattr(lc, "loss_function"):
+                continue
+            lm = None if label_masks is None else label_masks[i]
+            total = total + compute_loss(lc.loss_function, outs[i], labels[i], lm)
+        for name, impl in self.layer_impls.items():
+            total = total + impl.l1_l2_penalty(params[name])
+        return total, new_state
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _train_step(self):
+        gc = self.conf.global_conf
+
+        def step(params, updater_state, net_state, iteration, inputs, labels,
+                 feature_masks, label_masks, rng):
+            with dtypes_mod.policy_scope(self._policy):
+                def loss_fn(p):
+                    return self._loss_and_state(
+                        p, net_state, inputs, labels, feature_masks,
+                        label_masks, rng, train=True)
+
+                (loss, new_net_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                scale = lr_policy_scale(
+                    gc.lr_policy, iteration, gc.lr_policy_decay_rate,
+                    gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
+                    base_lr=gc.learning_rate)
+                new_params, new_updater = {}, {}
+                for name, spec in self.updater_specs.items():
+                    steps_i, upd_i = apply_updater(
+                        spec, grads[name], updater_state[name], scale,
+                        iteration + 1)
+                    new_params[name] = jax.tree_util.tree_map(
+                        lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
+                    new_updater[name] = upd_i
+            return new_params, new_updater, new_net_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _output_fn(self):
+        def out(params, net_state, inputs):
+            with dtypes_mod.policy_scope(self._policy):
+                outs, _ = self._forward(params, net_state, inputs,
+                                        train=False, rng=None)
+            return outs
+
+        return jax.jit(out)
+
+    # ------------------------------------------------------------------
+    # fit (ComputationGraph.fit :449-563)
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, num_epochs: int = 1):
+        self._ensure_init()
+        if labels is not None:
+            data = MultiDataSet([data] if not isinstance(data, (list, tuple)) else data,
+                                [labels] if not isinstance(labels, (list, tuple)) else labels)
+        if isinstance(data, DataSet):
+            data = MultiDataSet.from_dataset(data)
+        if isinstance(data, MultiDataSet):
+            self._fit_batches([data])
+            return self
+        for _ in range(num_epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            self._fit_batches(data)
+        return self
+
+    def _fit_batches(self, batches):
+        gc = self.conf.global_conf
+        for mds in batches:
+            if isinstance(mds, DataSet):
+                mds = MultiDataSet.from_dataset(mds)
+            for _ in range(max(1, gc.iterations)):
+                self._rng, rng = jax.random.split(self._rng)
+                inputs = tuple(jnp.asarray(f) for f in mds.features)
+                labels = tuple(jnp.asarray(l) for l in mds.labels)
+                fms = (None if mds.features_masks is None else tuple(
+                    None if m is None else jnp.asarray(m) for m in mds.features_masks))
+                lms = (None if mds.labels_masks is None else tuple(
+                    None if m is None else jnp.asarray(m) for m in mds.labels_masks))
+                (self.params, self.updater_state, self.net_state, loss) = (
+                    self._train_step(
+                        self.params, self.updater_state, self.net_state,
+                        jnp.asarray(self.iteration_count, jnp.int32),
+                        inputs, labels, fms, lms, rng))
+                self.score_value = float(loss)
+                self.iteration_count += 1
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration_count)
+
+    # ------------------------------------------------------------------
+    def output(self, *inputs) -> List[jnp.ndarray]:
+        self._ensure_init()
+        return self._output_fn(self.params, self.net_state,
+                               tuple(jnp.asarray(x) for x in inputs))
+
+    def feed_forward(self, *inputs) -> Dict[str, jnp.ndarray]:
+        self._ensure_init()
+        with dtypes_mod.policy_scope(self._policy):
+            values, _ = self._forward(
+                self.params, self.net_state,
+                tuple(jnp.asarray(x) for x in inputs),
+                train=False, rng=None, collect=True)
+        return values
+
+    def score(self, mds) -> float:
+        self._ensure_init()
+        if isinstance(mds, DataSet):
+            mds = MultiDataSet.from_dataset(mds)
+        with dtypes_mod.policy_scope(self._policy):
+            loss, _ = self._loss_and_state(
+                self.params, self.net_state,
+                tuple(jnp.asarray(f) for f in mds.features),
+                tuple(jnp.asarray(l) for l in mds.labels),
+                None if mds.features_masks is None else tuple(
+                    None if m is None else jnp.asarray(m) for m in mds.features_masks),
+                None if mds.labels_masks is None else tuple(
+                    None if m is None else jnp.asarray(m) for m in mds.labels_masks),
+                rng=None, train=False)
+        self.score_value = float(loss)
+        return self.score_value
+
+    def evaluate(self, iterator_or_ds, output_index: int = 0):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = Evaluation()
+        batches = iterator_or_ds
+        if isinstance(batches, (DataSet, MultiDataSet)):
+            batches = [batches]
+        elif hasattr(batches, "reset"):
+            batches.reset()
+        for ds in batches:
+            if isinstance(ds, DataSet):
+                ds = MultiDataSet.from_dataset(ds)
+            outs = self.output(*ds.features)
+            lm = None
+            if ds.labels_masks is not None and ds.labels_masks[output_index] is not None:
+                lm = np.asarray(ds.labels_masks[output_index])
+            ev.eval(np.asarray(ds.labels[output_index]),
+                    np.asarray(outs[output_index]), mask=lm)
+        return ev
+
+    def num_params(self) -> int:
+        self._ensure_init()
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(self.params))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def get_param_table(self) -> Dict[str, np.ndarray]:
+        self._ensure_init()
+        from deeplearning4j_tpu.nn.multilayer import _named_leaves
+
+        table = {}
+        for name in sorted(self.params):
+            for path, leaf in _named_leaves(self.params[name]):
+                table[f"{name}_{path}"] = np.asarray(leaf)
+        return table

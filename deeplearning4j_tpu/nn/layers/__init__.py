@@ -1,0 +1,19 @@
+"""Executable layers: pure ``init_params``/``forward`` keyed by config class.
+
+The reference pairs each ``nn/conf/layers`` config with an imperative
+implementation in ``nn/layers`` carrying hand-written ``activate``/
+``backpropGradient`` (BaseLayer.java:143). Here each implementation is a pure
+function of (params, inputs, state, rng); the backward pass comes from
+``jax.grad`` over the whole network, so only forward semantics live here.
+"""
+
+from deeplearning4j_tpu.nn.layers.base import (  # noqa: F401
+    LayerImpl,
+    get_layer_impl,
+    register_layer_impl,
+)
+from deeplearning4j_tpu.nn.layers import feedforward  # noqa: F401
+from deeplearning4j_tpu.nn.layers import convolution  # noqa: F401
+from deeplearning4j_tpu.nn.layers import normalization  # noqa: F401
+from deeplearning4j_tpu.nn.layers import recurrent  # noqa: F401
+from deeplearning4j_tpu.nn.layers import pretrain  # noqa: F401

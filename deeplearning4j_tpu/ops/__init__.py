@@ -1,0 +1,18 @@
+"""Tensor/op substrate — the framework's equivalent of the ND4J op catalog.
+
+The reference delegates every tensor op to the external ND4J library
+(activation transforms, losses, updater math, GEMM/conv; see SURVEY §2.2,
+citing deeplearning4j-core/pom.xml:153-158). Here the op catalog is a thin,
+typed layer over ``jax.numpy``/``jax.lax`` that XLA fuses into single TPU
+programs — there is no per-op dispatch at runtime.
+"""
+
+from deeplearning4j_tpu.ops.activations import (  # noqa: F401
+    get_activation,
+    activation_names,
+)
+from deeplearning4j_tpu.ops.losses import (  # noqa: F401
+    LossFunction,
+    compute_loss,
+)
+from deeplearning4j_tpu.ops.initializers import init_weights  # noqa: F401

@@ -1,0 +1,105 @@
+"""Activation registry.
+
+The reference configures activations as strings and executes them through
+``Nd4j.getExecutioner().execAndReturn(createTransform(name, ...))`` (SURVEY
+§2.2: sigmoid/softmax/tanh/relu/identity/softsign call-site counts). Here each
+activation is a pure jax function; inside ``jit`` XLA fuses it into the
+surrounding matmul, so the registry is a config-time concern only.
+
+String names mirror the reference's config DSL (``activation("tanh")`` etc. in
+nn/conf/layers/Layer.java:307) so JSON configs written against the reference
+vocabulary load unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _identity(x):
+    return x
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def _softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _rationaltanh(x):
+    # Rational approximation of tanh used by nd4j's RationalTanh transform:
+    # 1.7159 * softsign-style rational curve; cheap on scalar units, but on
+    # TPU we keep it mainly for config parity.
+    a = 0.6666667 * x
+    return 1.7159 * a / (1.0 + jnp.abs(a))
+
+
+def _softmax(x):
+    # Row-wise softmax over the feature (last) axis, matching nd4j SoftMax
+    # semantics on [batch, features] activations.
+    return jax.nn.softmax(x, axis=-1)
+
+
+_REGISTRY: Dict[str, Activation] = {
+    "identity": _identity,
+    "linear": _identity,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": _leakyrelu,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "softmax": _softmax,
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softsign": _softsign,
+    "softplus": jax.nn.softplus,
+    "hardtanh": _hardtanh,
+    "hardsigmoid": _hardsigmoid,
+    "cube": _cube,
+    "rationaltanh": _rationaltanh,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "exp": jnp.exp,
+}
+
+
+def get_activation(name: str) -> Activation:
+    """Look up an activation by its config-DSL name (case-insensitive)."""
+    fn = _REGISTRY.get(name.lower())
+    if fn is None:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_REGISTRY)}"
+        )
+    return fn
+
+
+def activation_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def register_activation(name: str, fn: Activation) -> None:
+    """Register a custom activation (the reference's CUSTOM escape hatch)."""
+    _REGISTRY[name.lower()] = fn

@@ -1,0 +1,102 @@
+"""Weight initialization schemes.
+
+Mirrors the reference's ``WeightInit`` enum (nn/weights/WeightInit.java:
+DISTRIBUTION, NORMALIZED, SIZE, UNIFORM, VI, ZERO, XAVIER, RELU) and
+``WeightInitUtil.java:81-106`` semantics, expressed with jax's functional PRNG
+instead of a global ND4J RNG.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: str = "XAVIER",
+    fan_in: Optional[int] = None,
+    fan_out: Optional[int] = None,
+    distribution: Optional[dict] = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Sample a weight tensor.
+
+    ``fan_in``/``fan_out`` default to shape[0]/shape[-1] for 2-D matrices; conv
+    layers pass receptive-field-scaled fans explicitly.
+    """
+    shape = tuple(int(s) for s in shape)
+    if fan_in is None:
+        fan_in = shape[0] if len(shape) >= 1 else 1
+    if fan_out is None:
+        fan_out = shape[-1] if len(shape) >= 2 else shape[0]
+    scheme = scheme.upper()
+
+    if scheme == "ZERO":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ONES":
+        return jnp.ones(shape, dtype)
+    if scheme == "UNIFORM":
+        # reference: U(-a, a) with a = 1/sqrt(fanIn)
+        a = 1.0 / jnp.sqrt(float(fan_in))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "XAVIER":
+        # reference WeightInitUtil: N(0,1) * sqrt(2/(fanIn+fanOut))
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / (fan_in + fan_out)).astype(dtype)
+    if scheme == "XAVIER_UNIFORM":
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "RELU":
+        # He init: N(0,1) * sqrt(2/fanIn)
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in).astype(dtype)
+    if scheme == "LECUN":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / fan_in).astype(dtype)
+    if scheme == "VI":
+        # reference: U(-r, r), r = 4 * sqrt(6/(fanIn+fanOut))
+        r = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "SIZE":
+        # reference SIZE: uniform scaled by sqrt of shape product heuristic
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "NORMALIZED":
+        # reference: U(0,1) - 0.5 scaled by 1/shape heuristic
+        return (jax.random.uniform(key, shape, dtype) - 0.5) / jnp.asarray(float(shape[0]), dtype)
+    if scheme == "DISTRIBUTION":
+        return _from_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"unknown weight init scheme {scheme!r}")
+
+
+def _from_distribution(key, shape, dist: dict, dtype):
+    """DISTRIBUTION init from a config dict: the reference's nd4j Distribution
+    polymorphic configs (NormalDistribution/UniformDistribution/
+    BinomialDistribution — nn/conf serde)."""
+    kind = dist.get("type", "normal").lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", dist.get("sd", 1.0)))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lower = float(dist.get("lower", -1.0))
+        upper = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lower, upper)
+    if kind == "binomial":
+        n = int(dist.get("n", dist.get("numberOfTrials", 1)))
+        p = float(dist.get("p", dist.get("probabilityOfSuccess", 0.5)))
+        return jnp.asarray(
+            jax.random.binomial(key, n, p, shape=shape), dtype
+        )
+    raise ValueError(f"unknown distribution {kind!r}")
+
+
+def conv_fans(kernel_shape: Tuple[int, ...]) -> Tuple[int, int]:
+    """fan_in/fan_out for a conv kernel in HWIO layout [kh, kw, in_c, out_c]."""
+    receptive = 1
+    for k in kernel_shape[:-2]:
+        receptive *= int(k)
+    fan_in = receptive * int(kernel_shape[-2])
+    fan_out = receptive * int(kernel_shape[-1])
+    return fan_in, fan_out

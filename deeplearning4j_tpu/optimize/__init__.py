@@ -1,0 +1,19 @@
+"""Optimization: solvers for full-batch algorithms + iteration listeners.
+
+The reference routes ALL training through ``optimize/Solver.java`` (dispatch
+:57-72 over OptimizationAlgorithm) with BaseOptimizer's loop (gradientAndScore
+→ line search → step → listeners, solvers/BaseOptimizer.java:165). Here the
+hot path (STOCHASTIC_GRADIENT_DESCENT) is fused into the network's jitted
+train step; this package provides the host-driven solvers — line gradient
+descent, conjugate gradient, LBFGS with backtracking line search — which
+re-enter a single jitted value-and-grad function without recompiling
+(SURVEY hard-part #5).
+"""
+
+from deeplearning4j_tpu.optimize.solver import Solver  # noqa: F401
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    ComposableIterationListener,
+    IterationListener,
+    ParamAndGradientIterationListener,
+    ScoreIterationListener,
+)

@@ -1,0 +1,99 @@
+"""Iteration listeners — the observability bus around the training loop.
+
+Mirror of optimize/api/IterationListener.java + listeners/
+ScoreIterationListener.java (score log every N iters) and
+ParamAndGradientIterationListener.java (per-param stats to file). Listeners
+fire host-side after each jitted step; anything they read (score, param
+norms) forces a device sync, so heavyweight listeners should run at a stride.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Logs score every ``print_iterations`` (ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Optional[Callable] = None):
+        self.print_iterations = max(1, int(print_iterations))
+        self.printer = printer or (lambda msg: log.info(msg))
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.print_iterations == 0:
+            self.printer(f"Score at iteration {iteration} is {model.score_value}")
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration):
+        for l in self.listeners:
+            l.iteration_done(model, iteration)
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-parameter statistics appended to a TSV file
+    (ParamAndGradientIterationListener.java, 231 LoC)."""
+
+    def __init__(self, path: str, iterations: int = 1):
+        self.path = path
+        self.iterations = max(1, iterations)
+        self._wrote_header = False
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.iterations:
+            return
+        table = model.get_param_table()
+        with open(self.path, "a") as f:
+            if not self._wrote_header:
+                f.write("iteration\tscore\tparam\tmean\tstd\tl2\n")
+                self._wrote_header = True
+            for name, arr in table.items():
+                arr = np.asarray(arr, np.float64)
+                f.write(
+                    f"{iteration}\t{model.score_value}\t{name}\t"
+                    f"{arr.mean():.6e}\t{arr.std():.6e}\t"
+                    f"{np.linalg.norm(arr.ravel()):.6e}\n"
+                )
+
+
+class TimeIterationListener(IterationListener):
+    """Steady-state steps/sec tracker (used by bench + perf tests)."""
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self.start_time: Optional[float] = None
+        self.count = 0
+
+    def iteration_done(self, model, iteration):
+        self.count += 1
+        if self.count == self.warmup:
+            self.start_time = time.perf_counter()
+
+    def steps_per_second(self) -> float:
+        if self.start_time is None or self.count <= self.warmup:
+            return 0.0
+        return (self.count - self.warmup) / (time.perf_counter() - self.start_time)
+
+
+class CollectScoresIterationListener(IterationListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_value))

@@ -1,0 +1,190 @@
+"""Host-driven convex solvers over the jitted score function.
+
+Implements the reference's OptimizationAlgorithm family
+(optimize/solvers/: StochasticGradientDescent, LineGradientDescent,
+ConjugateGradient, LBFGS + BackTrackLineSearch.java) as numpy/JAX hybrid
+loops: the score+gradient of the whole network w.r.t. the flat parameter
+vector is ONE jitted XLA callable; the solver logic (search directions,
+Armijo backtracking, L-BFGS two-loop recursion, termination conditions
+Eps/Norm2/ZeroDirection) runs on host exactly because it is control-flow
+heavy and O(params) cheap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking line search (BackTrackLineSearch.java)."""
+
+    def __init__(self, score_fn, max_iterations: int = 5, c1: float = 1e-4,
+                 shrink: float = 0.5, initial_step: float = 1.0):
+        self.score_fn = score_fn
+        self.max_iterations = max_iterations
+        self.c1 = c1
+        self.shrink = shrink
+        self.initial_step = initial_step
+
+    def optimize(self, params: np.ndarray, score0: float, grad: np.ndarray,
+                 direction: np.ndarray) -> float:
+        """Returns a step size along ``direction``."""
+        slope = float(np.dot(grad, direction))
+        if slope >= 0:  # not a descent direction — ZeroDirection guard
+            return 0.0
+        step = self.initial_step
+        for _ in range(self.max_iterations):
+            new_score = float(self.score_fn(params + step * direction))
+            if new_score <= score0 + self.c1 * step * slope:
+                return step
+            step *= self.shrink
+        return step
+
+
+class Solver:
+    """Per-network solver; dispatches on conf.optimization_algo."""
+
+    def __init__(self, network):
+        self.network = network
+        self.conf = network.conf.global_conf
+
+    # one jitted flat-params value_and_grad per network (cached there)
+    def _value_and_grad(self, ds):
+        net = self.network
+        if not hasattr(net, "_flat_vg_cache"):
+            net._flat_vg_cache = {}
+        shape_key = (ds.features.shape, None if ds.labels is None else ds.labels.shape)
+        if shape_key not in net._flat_vg_cache:
+            template = net.params
+            n_layers = len(net.layers)
+
+            def unflatten(flat):
+                # MUST match get_flat_params ordering: numeric layer order,
+                # then recursively sorted param names (NOT jax tree_flatten's
+                # lexicographic dict order, which sorts "10" before "2").
+                def rebuild(tree, offset):
+                    if isinstance(tree, dict):
+                        out = {}
+                        for k in sorted(tree):
+                            out[k], offset = rebuild(tree[k], offset)
+                        return out, offset
+                    size = tree.size
+                    chunk = flat[offset:offset + size].reshape(tree.shape).astype(tree.dtype)
+                    return chunk, offset + size
+
+                result, offset = {}, 0
+                for i in range(n_layers):
+                    result[str(i)], offset = rebuild(template[str(i)], offset)
+                return result
+
+            def loss_flat(flat, x, y, fm, lm):
+                p = unflatten(flat)
+                loss, _ = net._loss_and_state(p, net.net_state, x, y, fm, lm,
+                                              rng=None, train=False)
+                return loss
+
+            net._flat_vg_cache[shape_key] = (
+                jax.jit(jax.value_and_grad(loss_flat)),
+                jax.jit(loss_flat),
+            )
+        return net._flat_vg_cache[shape_key]
+
+    def optimize(self, ds, iterations: Optional[int] = None) -> float:
+        net = self.network
+        algo = self.conf.optimization_algo
+        iterations = iterations or max(1, self.conf.iterations)
+        vg, loss_fn = self._value_and_grad(ds)
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        score_of = lambda flat: loss_fn(jnp.asarray(flat), x, y, fm, lm)
+        params = np.asarray(net.get_flat_params(), np.float64)
+
+        line = BackTrackLineSearch(
+            score_of, max_iterations=self.conf.max_num_line_search_iterations)
+        lr = self.conf.learning_rate
+
+        # CG / LBFGS memory
+        prev_grad = None
+        prev_params = None
+        direction = None
+        lbfgs_s, lbfgs_y = [], []
+        m = 10
+
+        score = None
+        for it in range(iterations):
+            score_j, grad_j = vg(jnp.asarray(params), x, y, fm, lm)
+            score = float(score_j)
+            grad = np.asarray(grad_j, np.float64)
+            gnorm = float(np.linalg.norm(grad))
+            if gnorm < 1e-10:  # Norm2Termination
+                break
+
+            if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+                params = params - lr * grad
+            elif algo == OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
+                direction = -grad
+                step = line.optimize(params, score, grad, direction)
+                params = params + step * direction
+            elif algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
+                if prev_grad is None:
+                    direction = -grad
+                else:
+                    # Polak–Ribière with automatic restart
+                    beta = max(0.0, float(np.dot(grad, grad - prev_grad)
+                                          / (np.dot(prev_grad, prev_grad) + 1e-20)))
+                    direction = -grad + beta * direction
+                step = line.optimize(params, score, grad, direction)
+                params = params + step * direction
+                prev_grad = grad
+            elif algo == OptimizationAlgorithm.LBFGS:
+                # update memory with the (s, y) pair from the previous step
+                if prev_grad is not None and prev_params is not None:
+                    s_k = params - prev_params
+                    y_k = grad - prev_grad
+                    if np.dot(s_k, y_k) > 1e-10:  # curvature condition
+                        lbfgs_s.append(s_k)
+                        lbfgs_y.append(y_k)
+                        if len(lbfgs_s) > m:
+                            lbfgs_s.pop(0)
+                            lbfgs_y.pop(0)
+                # two-loop recursion
+                q = grad.copy()
+                alphas = []
+                for s_i, y_i in zip(reversed(lbfgs_s), reversed(lbfgs_y)):
+                    rho = 1.0 / (np.dot(y_i, s_i) + 1e-20)
+                    a = rho * np.dot(s_i, q)
+                    q -= a * y_i
+                    alphas.append((rho, a, s_i, y_i))
+                if lbfgs_y:
+                    gamma = (np.dot(lbfgs_s[-1], lbfgs_y[-1])
+                             / (np.dot(lbfgs_y[-1], lbfgs_y[-1]) + 1e-20))
+                    q *= gamma
+                for rho, a, s_i, y_i in reversed(alphas):
+                    b = rho * np.dot(y_i, q)
+                    q += (a - b) * s_i
+                direction = -q
+                step = line.optimize(params, score, grad, direction)
+                prev_params = params.copy()
+                prev_grad = grad
+                params = params + step * direction
+            else:
+                raise ValueError(f"unknown algorithm {algo}")
+
+            net.iteration_count += 1
+            net.score_value = score
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration_count)
+
+        net.set_flat_params(params.astype(np.float32))
+        if score is not None:
+            net.score_value = score
+        return net.score_value

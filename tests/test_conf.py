@@ -1,0 +1,231 @@
+"""Config DSL tests: builder, JSON round-trip, shape inference.
+
+Models the reference's nn/conf test suite
+(MultiLayerNeuralNetConfigurationTest.java, LayerConfigTest.java — SURVEY §4:
+"JSON↔object round-trip for every layer type; validation errors").
+"""
+
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    ComputationGraphConfiguration,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    Updater,
+    WeightInit,
+)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToRnnPreProcessor,
+)
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def mlp_conf():
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .learning_rate(0.05)
+        .updater(Updater.ADAM)
+        .weight_init(WeightInit.XAVIER)
+        .list()
+        .layer(0, L.DenseLayer(n_in=8, n_out=16, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=16, n_out=3,
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+
+
+class TestBuilder:
+    def test_global_defaults_applied(self):
+        conf = mlp_conf()
+        assert conf.layers[0].updater == Updater.ADAM
+        assert conf.layers[0].learning_rate == 0.05
+        assert conf.global_conf.seed == 42
+
+    def test_layer_overrides_global(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .learning_rate(0.1)
+            .list()
+            .layer(0, L.DenseLayer(n_in=2, n_out=2, learning_rate=0.9))
+            .layer(1, L.OutputLayer(n_in=2, n_out=2))
+            .build()
+        )
+        assert conf.layers[0].learning_rate == 0.9
+        assert conf.layers[1].learning_rate == 0.1
+
+    def test_contiguous_indices_enforced(self):
+        b = NeuralNetConfiguration.Builder().list()
+        b.layer(0, L.DenseLayer(n_in=2, n_out=2))
+        b.layer(2, L.OutputLayer(n_in=2, n_out=2))
+        with pytest.raises(ValueError):
+            b.build()
+
+    def test_missing_nin_caught(self):
+        b = (NeuralNetConfiguration.Builder().list()
+             .layer(0, L.DenseLayer(n_out=4)))
+        with pytest.raises(ValueError):
+            b.build()
+
+
+ALL_LAYER_CONFS = [
+    L.DenseLayer(n_in=4, n_out=5, activation="relu"),
+    L.OutputLayer(n_in=5, n_out=3, loss_function=LossFunction.MCXENT),
+    L.RnnOutputLayer(n_in=5, n_out=3),
+    L.LossLayer(),
+    L.EmbeddingLayer(n_in=100, n_out=8),
+    L.ActivationLayer(activation="tanh"),
+    L.DropoutLayer(dropout=0.5),
+    L.ConvolutionLayer(n_in=1, n_out=6, kernel_size=(5, 5), stride=(1, 1)),
+    L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+    L.BatchNormalization(n_in=7, n_out=7),
+    L.LocalResponseNormalization(),
+    L.GravesLSTM(n_in=4, n_out=6),
+    L.GravesBidirectionalLSTM(n_in=4, n_out=6),
+    L.GRU(n_in=4, n_out=6),
+    L.LSTM(n_in=4, n_out=6),
+    L.AutoEncoder(n_in=10, n_out=4, corruption_level=0.2),
+    L.RBM(n_in=10, n_out=4, k=2),
+]
+
+
+class TestSerde:
+    @pytest.mark.parametrize("layer", ALL_LAYER_CONFS,
+                             ids=lambda l: type(l).__name__)
+    def test_layer_roundtrip(self, layer):
+        d = layer.to_dict()
+        restored = L.LayerConf.from_dict(d)
+        assert type(restored) is type(layer)
+        assert restored.to_dict() == d
+
+    def test_multilayer_json_roundtrip(self):
+        conf = mlp_conf()
+        js = conf.to_json()
+        restored = MultiLayerConfiguration.from_json(js)
+        assert restored == conf
+        assert restored.to_json() == js
+
+    def test_preprocessor_roundtrip(self):
+        conf = (
+            NeuralNetConfiguration.Builder().list()
+            .layer(0, L.DenseLayer(n_in=12, n_out=4))
+            .layer(1, L.OutputLayer(n_in=4, n_out=2))
+            .input_pre_processor(0, CnnToFeedForwardPreProcessor(2, 2, 3))
+            .build()
+        )
+        restored = MultiLayerConfiguration.from_json(conf.to_json())
+        assert isinstance(restored.input_preprocessors[0],
+                          CnnToFeedForwardPreProcessor)
+        assert restored == conf
+
+
+class TestShapeInference:
+    def test_lenet_shapes(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+            .layer(1, L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(2, L.ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
+            .layer(3, L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(4, L.DenseLayer(n_out=500, activation="relu"))
+            .layer(5, L.OutputLayer(n_out=10))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build()
+        )
+        assert conf.layers[0].n_in == 1
+        assert conf.layers[2].n_in == 20
+        # 28 → conv5 → 24 → pool2 → 12 → conv5 → 8 → pool2 → 4
+        assert conf.layers[4].n_in == 4 * 4 * 50
+        assert conf.layers[5].n_in == 500
+        # CNN → FF preprocessor auto-inserted before the dense layer
+        assert 4 in conf.input_preprocessors
+
+    def test_rnn_inference(self):
+        conf = (
+            NeuralNetConfiguration.Builder().list()
+            .layer(0, L.GravesLSTM(n_out=32))
+            .layer(1, L.RnnOutputLayer(n_out=5))
+            .set_input_type(InputType.recurrent(10))
+            .build()
+        )
+        assert conf.layers[0].n_in == 10
+        assert conf.layers[1].n_in == 32
+
+    def test_ff_to_rnn_preprocessor_inserted(self):
+        conf = (
+            NeuralNetConfiguration.Builder().list()
+            .layer(0, L.DenseLayer(n_out=16))
+            .layer(1, L.GravesLSTM(n_out=8))
+            .layer(2, L.RnnOutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(10))
+            .build()
+        )
+        assert isinstance(conf.input_preprocessors[1], FeedForwardToRnnPreProcessor)
+        assert conf.layers[1].n_in == 16
+
+
+class TestGraphConf:
+    def build_graph(self):
+        return (
+            NeuralNetConfiguration.Builder()
+            .learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense1", L.DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("dense2", L.DenseLayer(n_in=4, n_out=8), "in")
+            .add_vertex("merge", MergeVertex(), "dense1", "dense2")
+            .add_layer("out", L.OutputLayer(n_in=16, n_out=3), "merge")
+            .set_outputs("out")
+            .build()
+        )
+
+    def test_topo_order(self):
+        conf = self.build_graph()
+        order = conf.topological_order
+        assert order.index("in") < order.index("dense1")
+        assert order.index("dense1") < order.index("merge")
+        assert order.index("merge") < order.index("out")
+
+    def test_json_roundtrip(self):
+        conf = self.build_graph()
+        restored = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert restored == conf
+
+    def test_cycle_detected(self):
+        from deeplearning4j_tpu.nn.conf.neural_net import GlobalConf
+
+        with pytest.raises(ValueError):
+            ComputationGraphConfiguration(
+                GlobalConf(), inputs=["in"], outputs=["a"],
+                layers={"a": L.DenseLayer(n_in=2, n_out=2),
+                        "b": L.DenseLayer(n_in=2, n_out=2)},
+                vertices={},
+                vertex_inputs={"a": ["b"], "b": ["a"]},
+            )
+
+    def test_unknown_input_detected(self):
+        b = (NeuralNetConfiguration.Builder().graph_builder()
+             .add_inputs("in")
+             .add_layer("out", L.OutputLayer(n_in=2, n_out=2), "missing")
+             .set_outputs("out"))
+        with pytest.raises(ValueError):
+            b.build()
+
+    def test_elementwise_vertex_conf(self):
+        conf = (
+            NeuralNetConfiguration.Builder().graph_builder()
+            .add_inputs("in")
+            .add_layer("a", L.DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("b", L.DenseLayer(n_in=4, n_out=8), "in")
+            .add_vertex("add", ElementWiseVertex(op="Add"), "a", "b")
+            .add_layer("out", L.OutputLayer(n_in=8, n_out=2), "add")
+            .set_outputs("out")
+            .build()
+        )
+        restored = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert restored.vertices["add"].op == "Add"

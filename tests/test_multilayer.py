@@ -1,0 +1,189 @@
+"""MultiLayerNetwork integration tests (MultiLayerTest.java analogues):
+shapes, param counts, training convergence on toy data, param pack/unpack."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    NeuralNetConfiguration,
+    OptimizationAlgorithm,
+    Updater,
+)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def toy_classification(n=256, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * 3.0
+    ys = rng.integers(0, classes, n)
+    xs = centers[ys] + rng.normal(size=(n, d))
+    labels = np.eye(classes)[ys]
+    return DataSet(xs.astype(np.float32), labels.astype(np.float32))
+
+
+def mlp_net(d=8, classes=3, updater=Updater.SGD, lr=0.1):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(7)
+        .learning_rate(lr)
+        .updater(updater)
+        .list()
+        .layer(0, L.DenseLayer(n_in=d, n_out=16, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=16, n_out=classes,
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestBasics:
+    def test_output_shapes(self):
+        net = mlp_net()
+        out = net.output(np.zeros((5, 8), np.float32))
+        assert out.shape == (5, 3)
+        np.testing.assert_allclose(np.sum(np.asarray(out), axis=1),
+                                   np.ones(5), rtol=1e-5)
+
+    def test_param_count(self):
+        net = mlp_net()
+        assert net.num_params() == 8 * 16 + 16 + 16 * 3 + 3
+
+    def test_feed_forward_collects_activations(self):
+        net = mlp_net()
+        acts = net.feed_forward(np.zeros((4, 8), np.float32))
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[1].shape == (4, 16)
+        assert acts[2].shape == (4, 3)
+
+    def test_param_roundtrip(self):
+        net = mlp_net()
+        flat = net.get_flat_params()
+        assert flat.shape == (net.num_params(),)
+        flat2 = flat + 1.0
+        net.set_flat_params(flat2)
+        np.testing.assert_allclose(net.get_flat_params(), flat2, rtol=1e-6)
+
+    def test_param_table_names(self):
+        net = mlp_net()
+        table = net.get_param_table()
+        assert set(table) == {"0_W", "0_b", "1_W", "1_b"}
+        assert table["0_W"].shape == (8, 16)
+
+    def test_deterministic_init(self):
+        n1, n2 = mlp_net(), mlp_net()
+        np.testing.assert_array_equal(n1.get_flat_params(), n2.get_flat_params())
+
+
+class TestTraining:
+    @pytest.mark.parametrize("updater", [
+        Updater.SGD, Updater.ADAM, Updater.ADAGRAD, Updater.RMSPROP,
+        Updater.NESTEROVS, Updater.ADADELTA,
+    ])
+    def test_score_decreases_all_updaters(self, updater):
+        ds = toy_classification()
+        lr = 0.5 if updater == Updater.ADADELTA else 0.05
+        net = mlp_net(updater=updater, lr=lr)
+        initial = net.score(ds)
+        it = ListDataSetIterator(ds, batch_size=64)
+        net.fit(it, num_epochs=20)
+        final = net.score(ds)
+        assert final < initial * 0.8, (updater, initial, final)
+
+    def test_learns_toy_problem(self):
+        ds = toy_classification()
+        net = mlp_net(updater=Updater.ADAM, lr=0.01)
+        it = ListDataSetIterator(ds, batch_size=64)
+        net.fit(it, num_epochs=30)
+        ev = net.evaluate(ds)
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    def test_predict(self):
+        ds = toy_classification(n=32)
+        net = mlp_net()
+        preds = net.predict(ds.features)
+        assert preds.shape == (32,)
+        assert preds.dtype.kind == "i"
+
+    def test_fit_features_labels_signature(self):
+        ds = toy_classification(n=64)
+        net = mlp_net()
+        net.fit(ds.features, ds.labels)
+        assert np.isfinite(net.score_value)
+
+    def test_listeners_fire(self):
+        from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+        ds = toy_classification(n=64)
+        net = mlp_net()
+        listener = CollectScoresIterationListener()
+        net.set_listeners(listener)
+        net.fit(ListDataSetIterator(ds, batch_size=32), num_epochs=2)
+        assert len(listener.scores) == 4  # 2 batches × 2 epochs
+
+
+class TestSolvers:
+    @pytest.mark.parametrize("algo", [
+        OptimizationAlgorithm.LINE_GRADIENT_DESCENT,
+        OptimizationAlgorithm.CONJUGATE_GRADIENT,
+        OptimizationAlgorithm.LBFGS,
+    ])
+    def test_full_batch_solvers_decrease_score(self, algo):
+        ds = toy_classification(n=128)
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(7)
+            .learning_rate(0.1)
+            .iterations(15)
+            .optimization_algo(algo)
+            .list()
+            .layer(0, L.DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=16, n_out=3))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        initial = net.score(ds)
+        net.fit(ds)
+        assert net.score(ds) < initial * 0.7, (algo, initial, net.score(ds))
+
+
+class TestDropoutAndRegularization:
+    def test_l2_shrinks_weights(self):
+        ds = toy_classification(n=128)
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.1).l2(0.5)
+            .list()
+            .layer(0, L.DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(1, L.OutputLayer(n_in=16, n_out=3))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        net_noreg = mlp_net(lr=0.1)
+        it = ListDataSetIterator(ds, batch_size=64)
+        net.fit(it, num_epochs=10)
+        it2 = ListDataSetIterator(ds, batch_size=64)
+        net_noreg.fit(it2, num_epochs=10)
+        w_reg = np.linalg.norm(net.get_param_table()["0_W"])
+        w_noreg = np.linalg.norm(net_noreg.get_param_table()["0_W"])
+        assert w_reg < w_noreg
+
+    def test_dropout_trains(self):
+        ds = toy_classification(n=128)
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(7).learning_rate(0.05)
+            .list()
+            .layer(0, L.DenseLayer(n_in=8, n_out=32, activation="relu",
+                                   dropout=0.5))
+            .layer(1, L.OutputLayer(n_in=32, n_out=3))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        initial = net.score(ds)
+        net.fit(ListDataSetIterator(ds, batch_size=64), num_epochs=15)
+        assert net.score(ds) < initial

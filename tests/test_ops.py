@@ -1,0 +1,127 @@
+"""Tensor-substrate tests: activations, losses (+masking), initializers."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.ops.activations import activation_names, get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss
+
+
+class TestActivations:
+    def test_known_values(self):
+        x = jnp.asarray([-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(get_activation("relu")(x), [0.0, 0.0, 1.0])
+        np.testing.assert_allclose(get_activation("identity")(x), x)
+        np.testing.assert_allclose(
+            get_activation("sigmoid")(jnp.asarray([0.0])), [0.5])
+        np.testing.assert_allclose(
+            get_activation("tanh")(x), np.tanh(np.asarray(x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            get_activation("softsign")(x), [-0.5, 0.0, 0.5])
+        np.testing.assert_allclose(get_activation("cube")(x), [-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(get_activation("hardtanh")(
+            jnp.asarray([-2.0, 0.5, 3.0])), [-1.0, 0.5, 1.0])
+
+    def test_softmax_rows_sum_to_one(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 7)))
+        s = get_activation("softmax")(x)
+        np.testing.assert_allclose(jnp.sum(s, axis=-1), np.ones(4), rtol=1e-6)
+
+    def test_all_registered_names_callable(self):
+        x = jnp.asarray([[0.1, 0.2], [0.3, 0.4]])
+        for name in activation_names():
+            y = get_activation(name)(x)
+            assert y.shape == x.shape
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            get_activation("nope")
+
+
+class TestLosses:
+    def test_mse(self):
+        out = jnp.asarray([[1.0, 2.0]])
+        y = jnp.asarray([[0.0, 0.0]])
+        # mean over features then batch: (1 + 4)/2 = 2.5
+        np.testing.assert_allclose(compute_loss("MSE", out, y), 2.5)
+
+    def test_mcxent_perfect_prediction_near_zero(self):
+        out = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        y = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        assert float(compute_loss(LossFunction.MCXENT, out, y)) < 1e-6
+
+    def test_mcxent_known_value(self):
+        out = jnp.asarray([[0.5, 0.5]])
+        y = jnp.asarray([[1.0, 0.0]])
+        np.testing.assert_allclose(
+            compute_loss(LossFunction.MCXENT, out, y), np.log(2.0), rtol=1e-5)
+
+    def test_xent_binary(self):
+        out = jnp.asarray([[0.5]])
+        y = jnp.asarray([[1.0]])
+        np.testing.assert_allclose(
+            compute_loss(LossFunction.XENT, out, y), np.log(2.0), rtol=1e-5)
+
+    def test_masking_excludes_entries(self):
+        out = jnp.asarray([[1.0, 0.0], [0.5, 0.5]])
+        y = jnp.asarray([[1.0, 0.0], [1.0, 0.0]])
+        mask = jnp.asarray([1.0, 0.0])
+        # only the perfect row counts
+        assert float(compute_loss("MCXENT", out, y, mask)) < 1e-6
+        mask2 = jnp.asarray([0.0, 1.0])
+        np.testing.assert_allclose(
+            compute_loss("MCXENT", out, y, mask2), np.log(2.0), rtol=1e-5)
+
+    def test_timeseries_mask(self):
+        # [b=1, t=2, f=2]: second step masked out
+        out = jnp.asarray([[[0.5, 0.5], [0.9, 0.1]]])
+        y = jnp.asarray([[[1.0, 0.0], [0.0, 1.0]]])
+        mask = jnp.asarray([[1.0, 0.0]])
+        np.testing.assert_allclose(
+            compute_loss("MCXENT", out, y, mask), np.log(2.0), rtol=1e-5)
+
+    def test_all_kinds_finite(self):
+        rng = np.random.default_rng(1)
+        out = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.normal(size=(3, 4)))))
+        y = jnp.asarray(np.eye(4)[rng.integers(0, 4, 3)])
+        for lf in LossFunction:
+            if lf == LossFunction.CUSTOM:
+                continue
+            v = float(compute_loss(lf, out, y))
+            assert np.isfinite(v), lf
+
+
+class TestInitializers:
+    def test_zero(self):
+        w = init_weights(jax.random.PRNGKey(0), (4, 5), "ZERO")
+        assert float(jnp.abs(w).max()) == 0.0
+
+    def test_xavier_scale(self):
+        w = init_weights(jax.random.PRNGKey(0), (2000, 1000), "XAVIER")
+        expected_std = np.sqrt(2.0 / 3000)
+        assert abs(float(w.std()) - expected_std) < 0.1 * expected_std
+
+    def test_relu_scale(self):
+        w = init_weights(jax.random.PRNGKey(0), (2000, 100), "RELU")
+        expected_std = np.sqrt(2.0 / 2000)
+        assert abs(float(w.std()) - expected_std) < 0.1 * expected_std
+
+    def test_uniform_bounds(self):
+        w = init_weights(jax.random.PRNGKey(0), (100, 100), "UNIFORM")
+        a = 1.0 / np.sqrt(100)
+        assert float(w.min()) >= -a and float(w.max()) <= a
+
+    def test_distribution_normal(self):
+        w = init_weights(
+            jax.random.PRNGKey(0), (5000,), "DISTRIBUTION",
+            distribution={"type": "normal", "mean": 2.0, "std": 0.5})
+        assert abs(float(w.mean()) - 2.0) < 0.05
+        assert abs(float(w.std()) - 0.5) < 0.05
+
+    def test_deterministic_per_key(self):
+        w1 = init_weights(jax.random.PRNGKey(7), (3, 3), "XAVIER")
+        w2 = init_weights(jax.random.PRNGKey(7), (3, 3), "XAVIER")
+        np.testing.assert_array_equal(w1, w2)

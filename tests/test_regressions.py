@@ -1,0 +1,100 @@
+"""Regression tests for review findings: nested-param regularization,
+solver flat-param ordering with 11+ layers, async iterator error propagation,
+rnn_time_step output rank."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def test_bilstm_with_l2_trains():
+    """Nested fwd/bwd param trees must survive l1_l2_penalty + updaters."""
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(0).learning_rate(0.05).l2(0.01)
+        .list()
+        .layer(0, L.GravesBidirectionalLSTM(n_in=4, n_out=6))
+        .layer(1, L.RnnOutputLayer(n_in=6, n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(3, 5, 4)).astype(np.float32)
+    y = np.zeros((3, 5, 2), np.float32)
+    y[..., 0] = 1.0
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+
+
+def test_solver_flat_ordering_many_layers():
+    """11+ layers: lexicographic dict order ('10' < '2') must not scramble
+    the flat param vector in the solver path."""
+    b = NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1) \
+        .iterations(3).optimization_algo(OptimizationAlgorithm.LINE_GRADIENT_DESCENT).list()
+    widths = [6, 7, 8, 9, 10, 11, 12, 11, 10, 9, 8]
+    prev = 5
+    for i, w in enumerate(widths):
+        b.layer(i, L.DenseLayer(n_in=prev, n_out=w, activation="tanh"))
+        prev = w
+    b.layer(len(widths), L.OutputLayer(n_in=prev, n_out=3))
+    net = MultiLayerNetwork(b.build()).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(32, 5)).astype(np.float32),
+                 np.eye(3)[rng.integers(0, 3, 32)].astype(np.float32))
+    initial = net.score(ds)
+    net.fit(ds)
+    assert np.isfinite(net.score_value)
+    assert net.score(ds) <= initial * 1.05  # no scrambling blow-up
+
+
+def test_async_iterator_propagates_errors():
+    class Boom(ListDataSetIterator):
+        def next(self, num=None):
+            if self._pos >= 1:
+                raise RuntimeError("corrupt batch")
+            return super().next(num)
+
+    ds = DataSet(np.zeros((40, 2), np.float32), np.zeros((40, 2), np.float32))
+    it = AsyncDataSetIterator(Boom(ds, batch_size=10))
+    with pytest.raises(RuntimeError, match="corrupt batch"):
+        consumed = 0
+        while it.has_next():
+            it.next()
+            consumed += 1
+
+
+def test_async_iterator_full_epoch():
+    ds = DataSet(np.arange(80, dtype=np.float32).reshape(40, 2),
+                 np.zeros((40, 2), np.float32))
+    it = AsyncDataSetIterator(ListDataSetIterator(ds, batch_size=10))
+    batches = [b for b in it]
+    assert len(batches) == 4
+    # reset works
+    batches2 = [b for b in it]
+    assert len(batches2) == 4
+    np.testing.assert_array_equal(batches[0].features, batches2[0].features)
+
+
+def test_rnn_time_step_2d_in_2d_out():
+    conf = (
+        NeuralNetConfiguration.Builder().seed(0).list()
+        .layer(0, L.GravesLSTM(n_in=4, n_out=6))
+        .layer(1, L.RnnOutputLayer(n_in=6, n_out=2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = np.ones((3, 4), np.float32)
+    out = net.rnn_time_step(x)
+    assert out.shape == (3, 2)
+    # state carried: second call differs from a cleared-state call
+    o2 = np.asarray(net.rnn_time_step(x))
+    net.rnn_clear_previous_state()
+    o3 = np.asarray(net.rnn_time_step(x))
+    assert not np.allclose(o2, o3)

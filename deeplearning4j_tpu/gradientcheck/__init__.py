@@ -1,0 +1,11 @@
+"""Gradient checking: central-difference validation of analytic gradients.
+
+Mirror of ``gradientcheck/GradientCheckUtil.java:48`` — the reference's
+gold-standard correctness harness (SURVEY §4 calls it "the backbone"). Here
+the analytic gradient comes from ``jax.grad`` over the network's loss; the
+check verifies our *loss/forward composition* (masking, regularization,
+preprocessors, scan-based recurrence) against central differences in float64,
+matching the reference's requirement that checks run in double precision.
+"""
+
+from deeplearning4j_tpu.gradientcheck.util import GradientCheckUtil, check_gradients  # noqa: F401

@@ -1,0 +1,3 @@
+"""Host-side utilities (serialization, misc math)."""
+
+from deeplearning4j_tpu.utils.serializer import ModelSerializer  # noqa: F401

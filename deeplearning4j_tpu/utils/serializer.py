@@ -1,0 +1,190 @@
+"""ModelSerializer: checkpoint write/restore.
+
+Mirror of ``util/ModelSerializer.java:31-96`` — a zip archive holding the
+full configuration JSON, the parameters, and the updater state (the
+reference's configuration.json + coefficients.bin + updater.bin; updater
+state is part of the checkpoint contract, SURVEY §5). We add the
+non-trainable network state (batchnorm running stats) and training metadata,
+which the reference loses on save.
+
+Entries:
+- ``configuration.json``  — MultiLayerConfiguration / ComputationGraphConfiguration JSON
+- ``coefficients.npz``    — named param arrays (flat "0_W"-style keys)
+- ``updater.npz``         — named updater-state arrays (optional)
+- ``state.npz``           — named net-state arrays (optional)
+- ``metadata.json``       — model type, iteration count, format version
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _escape(component: str) -> str:
+    """Escape '%' and '/' so user-chosen layer names containing '/' cannot
+    collide with the path delimiter."""
+    return component.replace("%", "%25").replace("/", "%2F")
+
+
+def _unescape(component: str) -> str:
+    return component.replace("%2F", "/").replace("%25", "%")
+
+
+def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            esc = _escape(str(k))
+            sub_prefix = f"{prefix}/{esc}" if prefix else esc
+            out.update(_flatten_tree(tree[k], sub_prefix))
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def _unflatten_tree(flat: Dict[str, np.ndarray]) -> Any:
+    root: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = [_unescape(p) for p in key.split("/")]
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(value)
+    return root
+
+
+def _write_npz(zf: zipfile.ZipFile, name: str, tree: Any) -> None:
+    flat = _flatten_tree(tree)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    zf.writestr(name, buf.getvalue())
+
+
+def _read_npz(zf: zipfile.ZipFile, name: str) -> Any:
+    with zf.open(name) as f:
+        data = np.load(io.BytesIO(f.read()))
+        return _unflatten_tree({k: data[k] for k in data.files})
+
+
+def _merge_into(template: Any, loaded: Any, path: str = "") -> Any:
+    """Overlay loaded leaves onto the freshly-initialized structure.
+
+    Empty-dict slots (param-less layers) are allowed to be absent from the
+    archive — np.savez drops them entirely — but a missing *array* leaf means
+    a truncated/corrupt checkpoint and raises rather than silently keeping
+    fresh-random-init values."""
+    if isinstance(template, dict):
+        out = {}
+        for k in template:
+            sub_path = f"{path}/{k}" if path else str(k)
+            sub_loaded = loaded.get(k) if isinstance(loaded, dict) else None
+            if sub_loaded is None and _has_array_leaves(template[k]):
+                raise ValueError(
+                    f"checkpoint is missing parameter entry {sub_path!r} "
+                    "(truncated or incompatible archive)")
+            out[k] = _merge_into(template[k], sub_loaded, sub_path)
+        return out
+    if loaded is None:
+        return template
+    return jnp.asarray(loaded, template.dtype) if hasattr(template, "dtype") else loaded
+
+
+def _has_array_leaves(tree: Any) -> bool:
+    if isinstance(tree, dict):
+        return any(_has_array_leaves(v) for v in tree.values())
+    return True
+
+
+class ModelSerializer:
+    FORMAT_VERSION = 1
+
+    @staticmethod
+    def write_model(model, path: str, save_updater: bool = True) -> None:
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        model._ensure_init()
+        if isinstance(model, MultiLayerNetwork):
+            mtype = "MultiLayerNetwork"
+        elif isinstance(model, ComputationGraph):
+            mtype = "ComputationGraph"
+        else:
+            raise TypeError(f"cannot serialize {type(model).__name__}")
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", model.conf.to_json())
+            _write_npz(zf, "coefficients.npz", model.params)
+            if save_updater:
+                _write_npz(zf, "updater.npz", model.updater_state)
+            _write_npz(zf, "state.npz", model.net_state)
+            zf.writestr(
+                "metadata.json",
+                json.dumps({
+                    "format_version": ModelSerializer.FORMAT_VERSION,
+                    "model_type": mtype,
+                    "iteration_count": model.iteration_count,
+                }),
+            )
+
+    @staticmethod
+    def restore_multi_layer_network(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("metadata.json"))
+            if meta["model_type"] != "MultiLayerNetwork":
+                raise TypeError(
+                    f"checkpoint holds a {meta['model_type']}, "
+                    "use restore_computation_graph")
+            conf = MultiLayerConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            net = MultiLayerNetwork(conf).init()
+            net.params = _merge_into(net.params, _read_npz(zf, "coefficients.npz"))
+            if load_updater and "updater.npz" in zf.namelist():
+                net.updater_state = _merge_into(
+                    net.updater_state, _read_npz(zf, "updater.npz"))
+            if "state.npz" in zf.namelist():
+                net.net_state = _merge_into(net.net_state, _read_npz(zf, "state.npz"))
+            net.iteration_count = meta.get("iteration_count", 0)
+        return net
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("metadata.json"))
+            if meta["model_type"] != "ComputationGraph":
+                raise TypeError(
+                    f"checkpoint holds a {meta['model_type']}, "
+                    "use restore_multi_layer_network")
+            conf = ComputationGraphConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            net = ComputationGraph(conf).init()
+            net.params = _merge_into(net.params, _read_npz(zf, "coefficients.npz"))
+            if load_updater and "updater.npz" in zf.namelist():
+                net.updater_state = _merge_into(
+                    net.updater_state, _read_npz(zf, "updater.npz"))
+            if "state.npz" in zf.namelist():
+                net.net_state = _merge_into(net.net_state, _read_npz(zf, "state.npz"))
+            net.iteration_count = meta.get("iteration_count", 0)
+        return net
+
+    @staticmethod
+    def restore(path: str, load_updater: bool = True):
+        """Type-dispatching restore."""
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("metadata.json"))
+        mtype = meta.get("model_type")
+        if mtype == "MultiLayerNetwork":
+            return ModelSerializer.restore_multi_layer_network(path, load_updater)
+        if mtype == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(path, load_updater)
+        raise ValueError(f"unknown model_type {mtype!r} in checkpoint metadata")

@@ -1,0 +1,148 @@
+"""Gradient checks — the correctness backbone (GradientCheckTests.java
+analogue): every layer family's forward composition validated against
+central differences in float64."""
+
+import numpy as np
+import jax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _net(builder_layers, input_type=None, l1=0.0, l2=0.0, seed=3):
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .dtype_policy("float64").l1(l1).l2(l2).list())
+    for i, layer in enumerate(builder_layers):
+        b.layer(i, layer)
+    if input_type is not None:
+        b.set_input_type(input_type)
+    with jax.enable_x64(True):  # init params genuinely in f64
+        return MultiLayerNetwork(b.build()).init()
+
+
+def _toy(n=8, d=5, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    y = np.eye(c)[rng.integers(0, c, n)]
+    return DataSet(x, y)
+
+
+class TestGradientChecks:
+    def test_mlp_tanh_mcxent(self):
+        net = _net([
+            L.DenseLayer(n_in=5, n_out=7, activation="tanh"),
+            L.OutputLayer(n_in=7, n_out=3, loss_function=LossFunction.MCXENT),
+        ])
+        assert check_gradients(net, _toy(), subset=40)
+
+    def test_mlp_relu_with_l1_l2(self):
+        net = _net([
+            L.DenseLayer(n_in=5, n_out=7, activation="softplus"),
+            L.OutputLayer(n_in=7, n_out=3),
+        ], l1=0.01, l2=0.02)
+        assert check_gradients(net, _toy(), subset=40)
+
+    def test_mse_identity_output(self):
+        rng = np.random.default_rng(1)
+        ds = DataSet(rng.normal(size=(6, 4)), rng.normal(size=(6, 2)))
+        net = _net([
+            L.DenseLayer(n_in=4, n_out=6, activation="sigmoid"),
+            L.OutputLayer(n_in=6, n_out=2, activation="identity",
+                          loss_function=LossFunction.MSE),
+        ])
+        assert check_gradients(net, ds, subset=40)
+
+    def test_cnn(self):
+        rng = np.random.default_rng(2)
+        ds = DataSet(rng.normal(size=(4, 6, 6, 1)),
+                     np.eye(2)[rng.integers(0, 2, 4)])
+        net = _net([
+            L.ConvolutionLayer(n_out=3, kernel_size=(3, 3), activation="tanh"),
+            L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+            L.OutputLayer(n_out=2),
+        ], input_type=InputType.convolutional(6, 6, 1))
+        assert check_gradients(net, ds, subset=40)
+
+    def test_lstm(self):
+        rng = np.random.default_rng(3)
+        ds = DataSet(rng.normal(size=(3, 4, 5)),
+                     np.eye(2)[rng.integers(0, 2, (3, 4))])
+        net = _net([
+            L.GravesLSTM(n_in=5, n_out=6),
+            L.RnnOutputLayer(n_in=6, n_out=2),
+        ])
+        assert check_gradients(net, ds, subset=40)
+
+    def test_lstm_with_mask(self):
+        rng = np.random.default_rng(4)
+        mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0], [1, 1, 1, 1]], np.float64)
+        ds = DataSet(rng.normal(size=(3, 4, 5)),
+                     np.eye(2)[rng.integers(0, 2, (3, 4))],
+                     features_mask=mask, labels_mask=mask)
+        net = _net([
+            L.GravesLSTM(n_in=5, n_out=4),
+            L.RnnOutputLayer(n_in=4, n_out=2),
+        ])
+        assert check_gradients(net, ds, subset=40)
+
+    def test_gru(self):
+        rng = np.random.default_rng(5)
+        ds = DataSet(rng.normal(size=(3, 4, 5)),
+                     np.eye(2)[rng.integers(0, 2, (3, 4))])
+        net = _net([
+            L.GRU(n_in=5, n_out=6),
+            L.RnnOutputLayer(n_in=6, n_out=2),
+        ])
+        assert check_gradients(net, ds, subset=40)
+
+    def test_bidirectional_lstm(self):
+        rng = np.random.default_rng(6)
+        ds = DataSet(rng.normal(size=(2, 3, 4)),
+                     np.eye(2)[rng.integers(0, 2, (2, 3))])
+        net = _net([
+            L.GravesBidirectionalLSTM(n_in=4, n_out=5),
+            L.RnnOutputLayer(n_in=5, n_out=2),
+        ])
+        assert check_gradients(net, ds, subset=40)
+
+    def test_batchnorm_dense(self):
+        net = _net([
+            L.DenseLayer(n_in=5, n_out=6, activation="tanh"),
+            L.BatchNormalization(),
+            L.OutputLayer(n_out=3),
+        ], input_type=InputType.feed_forward(5))
+        assert check_gradients(net, _toy(), subset=40)
+
+    def test_computation_graph(self):
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(3).dtype_policy("float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("a", L.DenseLayer(n_in=5, n_out=4, activation="tanh"), "in")
+            .add_layer("b", L.DenseLayer(n_in=5, n_out=4, activation="sigmoid"), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("out", L.OutputLayer(n_in=8, n_out=3), "m")
+            .set_outputs("out")
+            .build()
+        )
+        import jax as _jax
+        with _jax.enable_x64(True):
+            net = ComputationGraph(conf).init()
+        assert check_gradients(net, _toy(), subset=40)
+
+    def test_embedding(self):
+        rng = np.random.default_rng(7)
+        idx = rng.integers(0, 11, (6, 1)).astype(np.float64)
+        ds = DataSet(idx, np.eye(3)[rng.integers(0, 3, 6)])
+        net = _net([
+            L.EmbeddingLayer(n_in=11, n_out=5, activation="tanh"),
+            L.OutputLayer(n_in=5, n_out=3),
+        ])
+        assert check_gradients(net, ds, subset=30)

@@ -1,0 +1,172 @@
+"""ModelSerializer round-trip tests (checkpoint contract: conf JSON + params
++ updater state; util/ModelSerializer.java parity)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils import ModelSerializer
+
+
+def toy(n=64, d=6, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.eye(c)[rng.integers(0, c, n)].astype(np.float32)
+    return DataSet(x, y)
+
+
+def make_net():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(1).learning_rate(0.05).updater(Updater.ADAM)
+        .list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=12, activation="relu"))
+        .layer(1, L.BatchNormalization())
+        .layer(2, L.OutputLayer(n_out=3))
+        .set_input_type(InputType.feed_forward(6))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestModelSerializer:
+    def test_roundtrip_outputs_identical(self, tmp_path):
+        net = make_net()
+        ds = toy()
+        net.fit(ds)
+        path = str(tmp_path / "model.zip")
+        ModelSerializer.write_model(net, path)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        np.testing.assert_allclose(
+            np.asarray(net.output(ds.features)),
+            np.asarray(restored.output(ds.features)), rtol=1e-6)
+        assert restored.iteration_count == net.iteration_count
+
+    def test_updater_state_resumes_identically(self, tmp_path):
+        """Training N+M steps straight == N steps, checkpoint, restore, M
+        steps — the updater-state-in-checkpoint contract."""
+        ds = toy()
+        net_a = make_net()
+        for _ in range(5):
+            net_a.fit(ds)
+
+        net_b = make_net()
+        for _ in range(2):
+            net_b.fit(ds)
+        path = str(tmp_path / "ckpt.zip")
+        ModelSerializer.write_model(net_b, path)
+        net_c = ModelSerializer.restore_multi_layer_network(path)
+        for _ in range(3):
+            net_c.fit(ds)
+        np.testing.assert_allclose(
+            net_a.get_flat_params(), net_c.get_flat_params(), rtol=1e-4, atol=1e-6)
+
+    def test_without_updater(self, tmp_path):
+        net = make_net()
+        path = str(tmp_path / "m.zip")
+        ModelSerializer.write_model(net, path, save_updater=False)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        ds = toy(n=8)
+        np.testing.assert_allclose(
+            np.asarray(net.output(ds.features)),
+            np.asarray(restored.output(ds.features)), rtol=1e-6)
+
+    def test_wrong_type_raises(self, tmp_path):
+        net = make_net()
+        path = str(tmp_path / "m.zip")
+        ModelSerializer.write_model(net, path)
+        with pytest.raises(TypeError):
+            ModelSerializer.restore_computation_graph(path)
+
+    def test_dispatching_restore(self, tmp_path):
+        net = make_net()
+        path = str(tmp_path / "m.zip")
+        ModelSerializer.write_model(net, path)
+        restored = ModelSerializer.restore(path)
+        assert isinstance(restored, MultiLayerNetwork)
+
+    def test_graph_roundtrip(self, tmp_path):
+        conf = (
+            NeuralNetConfiguration.Builder().seed(2).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("a", L.DenseLayer(n_in=6, n_out=8), "in")
+            .add_layer("b", L.DenseLayer(n_in=6, n_out=8), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("out", L.OutputLayer(n_in=16, n_out=3), "m")
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        ds = toy()
+        net.fit(ds)
+        path = str(tmp_path / "graph.zip")
+        ModelSerializer.write_model(net, path)
+        restored = ModelSerializer.restore_computation_graph(path)
+        np.testing.assert_allclose(
+            np.asarray(net.output(ds.features)[0]),
+            np.asarray(restored.output(ds.features)[0]), rtol=1e-6)
+
+    def test_layer_names_with_slashes(self, tmp_path):
+        """'/' in user-chosen vertex names must not collide with the archive
+        path delimiter."""
+        conf = (
+            NeuralNetConfiguration.Builder().seed(5).graph_builder()
+            .add_inputs("in")
+            .add_layer("enc/dense", L.DenseLayer(n_in=6, n_out=8), "in")
+            .add_layer("out", L.OutputLayer(n_in=8, n_out=3), "enc/dense")
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        path = str(tmp_path / "slash.zip")
+        ModelSerializer.write_model(net, path)
+        restored = ModelSerializer.restore_computation_graph(path)
+        x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)[0]), np.asarray(restored.output(x)[0]),
+            rtol=1e-6)
+
+    def test_truncated_checkpoint_raises(self, tmp_path):
+        import io
+        import zipfile
+
+        net = make_net()
+        path = str(tmp_path / "trunc.zip")
+        ModelSerializer.write_model(net, path)
+        # rewrite the archive with a coefficients.npz missing layer "2"
+        with zipfile.ZipFile(path) as zf:
+            entries = {n: zf.read(n) for n in zf.namelist()}
+        data = np.load(io.BytesIO(entries["coefficients.npz"]))
+        kept = {k: data[k] for k in data.files if not k.startswith("2/")}
+        buf = io.BytesIO()
+        np.savez(buf, **kept)
+        entries["coefficients.npz"] = buf.getvalue()
+        with zipfile.ZipFile(path, "w") as zf:
+            for n, payload in entries.items():
+                zf.writestr(n, payload)
+        with pytest.raises(ValueError, match="missing parameter"):
+            ModelSerializer.restore_multi_layer_network(path)
+
+    def test_pooling_net_roundtrip(self, tmp_path):
+        """Param-less layers (pooling) must survive the npz round-trip."""
+        conf = (
+            NeuralNetConfiguration.Builder().seed(3).list()
+            .layer(0, L.ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(1, L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(2, L.OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        path = str(tmp_path / "cnn.zip")
+        ModelSerializer.write_model(net, path)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        x = np.random.default_rng(0).normal(size=(2, 8, 8, 1)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-6)

@@ -1,0 +1,84 @@
+"""Benchmark: LeNet-5 MNIST training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md), so the baseline is
+self-measured per BASELINE.json's north star: ">2x nd4j-native CPU
+throughput". Proxy for the nd4j-native CPU path: the SAME jitted LeNet train
+step executed on this host's CPU backend (XLA-CPU is a strictly faster
+stand-in for 2015-era ND4J op-by-op BLAS dispatch, so beating it by 2x is a
+conservative bar). ``vs_baseline`` = TPU samples/sec ÷ CPU samples/sec.
+
+Config (BASELINE.md row 2): LeNet-5, batch 256, synthetic MNIST-shaped data
+(throughput does not depend on pixel values; zero-egress image rules out the
+real download), bf16 compute / f32 params on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BATCH = 256
+WARMUP = 5
+STEPS = 30
+
+
+def _make_batch(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((BATCH, 28, 28, 1), np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+    return x, y
+
+
+def _throughput(net, x, y, steps=STEPS, warmup=WARMUP) -> float:
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        net.fit(ds)
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return BATCH * steps / dt
+
+
+def main() -> None:
+    import jax
+
+    from deeplearning4j_tpu.models import lenet5
+
+    x, y = _make_batch()
+
+    # TPU run (bf16 compute for the MXU)
+    tpu_sps = _throughput(lenet5(dtype_policy="bf16").init(), x, y)
+
+    # CPU baseline (f32; the stand-in for the reference's nd4j-native path)
+    try:
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            cpu_sps = _throughput(lenet5(dtype_policy="float32").init(), x, y,
+                                  steps=10, warmup=2)
+        vs_baseline = tpu_sps / cpu_sps
+    except Exception:
+        vs_baseline = float("nan")
+
+    print(json.dumps({
+        "metric": "lenet5_mnist_train_samples_per_sec_per_chip",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Flagship model builders — the configs the framework is benchmarked on.
+
+The reference's benchmark families (BASELINE.md): MNIST MLP, LeNet-5,
+GravesLSTM char-RNN, ResNet-18-class ComputationGraph, word2vec. Each builder
+returns a ready-to-init network using only the public config DSL — these
+double as executable documentation of the DSL.
+"""
+
+from deeplearning4j_tpu.models.zoo import (  # noqa: F401
+    char_lstm,
+    lenet5,
+    mnist_mlp,
+    resnet18,
+    transformer_lm,
+)

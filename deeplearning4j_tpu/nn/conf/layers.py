@@ -249,6 +249,25 @@ class SubsamplingLayer(LayerConf):
 
 @register_layer_conf
 @dataclasses.dataclass
+class GlobalPoolingLayer(LayerConf):
+    """Global pooling over spatial (CNN) or time (RNN) axes → FF output.
+    Mask-aware for variable-length series."""
+
+    pooling_type: PoolingType = PoolingType.AVG
+    pnorm: int = 2
+    activation: str = "identity"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "CNN":
+            return InputType.feed_forward(input_type.channels)
+        return InputType.feed_forward(input_type.size)
+
+    def infer_n_in(self, input_type: InputType) -> None:
+        pass  # no params
+
+
+@register_layer_conf
+@dataclasses.dataclass
 class BatchNormalization(LayerConf):
     """Batch norm (nn/layers/normalization/BatchNormalization.java: batch
     stats at :146-147, γ/β, lockGammaBeta :85, running-mean decay)."""

@@ -452,7 +452,8 @@ def _infer_shapes_and_preprocessors(
         layer.infer_n_in(current)
         if layer.n_out is None and not isinstance(
             layer, (L.SubsamplingLayer, L.ActivationLayer, L.BatchNormalization,
-                    L.LocalResponseNormalization, L.LossLayer, L.DropoutLayer)
+                    L.LocalResponseNormalization, L.LossLayer, L.DropoutLayer,
+                    L.GlobalPoolingLayer)
         ):
             raise ValueError(f"layer {i} ({type(layer).__name__}) needs n_out")
         current = layer.output_type(current)
@@ -496,7 +497,7 @@ def _validate(layers: List[LayerConf]) -> None:
         needs_nin = not isinstance(
             layer, (L.SubsamplingLayer, L.ActivationLayer, L.LossLayer,
                     L.DropoutLayer, L.LocalResponseNormalization,
-                    L.BatchNormalization)
+                    L.BatchNormalization, L.GlobalPoolingLayer)
         )
         if needs_nin and (layer.n_in is None or layer.n_out is None):
             raise ValueError(

@@ -61,11 +61,19 @@ class ComputationGraph:
         self.updater_state: Dict[str, Any] = {}
         self.updater_specs: Dict[str, UpdaterSpec] = {}
         self.iteration_count = 0
-        self.score_value = float("nan")
+        self._score = float("nan")
         self.listeners: List[Any] = []
         self._initialized = False
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
+
+    @property
+    def score_value(self) -> float:
+        return float(self._score)
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score = v
 
     # ------------------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -296,7 +304,7 @@ class ComputationGraph:
                         self.params, self.updater_state, self.net_state,
                         jnp.asarray(self.iteration_count, jnp.int32),
                         inputs, labels, fms, lms, rng))
-                self.score_value = float(loss)
+                self._score = loss  # device scalar; no per-step sync
                 self.iteration_count += 1
                 for listener in self.listeners:
                     listener.iteration_done(self, self.iteration_count)
@@ -330,7 +338,7 @@ class ComputationGraph:
                 None if mds.labels_masks is None else tuple(
                     None if m is None else jnp.asarray(m) for m in mds.labels_masks),
                 rng=None, train=False)
-        self.score_value = float(loss)
+        self._score = loss
         return self.score_value
 
     def evaluate(self, iterator_or_ds, output_index: int = 0):

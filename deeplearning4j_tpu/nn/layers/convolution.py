@@ -60,6 +60,52 @@ class ConvolutionImpl(LayerImpl):
         return self.activation_fn()(y), state
 
 
+@register_layer_impl(L.GlobalPoolingLayer)
+class GlobalPoolingImpl(LayerImpl):
+    """Mean/max/sum/pnorm over spatial axes (NHWC [b,h,w,c] → [b,c]) or the
+    time axis (RNN [b,t,f] → [b,f]); honors the feature mask for
+    variable-length series (masked steps excluded from the statistic)."""
+
+    def forward(self, params, x, state, *, train=False, rng=None, mask=None):
+        conf = self.conf
+        if x.ndim == 4:
+            axes = (1, 2)
+            m = None
+        elif x.ndim == 3:
+            axes = (1,)
+            m = None if mask is None else mask[..., None].astype(x.dtype)
+        else:
+            raise ValueError(f"GlobalPooling expects rank 3/4 input, got {x.ndim}")
+        pt = conf.pooling_type
+        if pt == PoolingType.MAX:
+            if m is not None:
+                x = jnp.where(m > 0, x, -jnp.inf)
+            y = jnp.max(x, axis=axes)
+            if m is not None:
+                # all-padding examples (mask row entirely 0) yield -inf;
+                # emit 0 instead so the loss/grads stay finite
+                any_valid = jnp.max(m, axis=axes) > 0
+                y = jnp.where(any_valid, y, 0.0)
+        elif pt == PoolingType.SUM:
+            if m is not None:
+                x = x * m
+            y = jnp.sum(x, axis=axes)
+        elif pt == PoolingType.AVG:
+            if m is not None:
+                y = jnp.sum(x * m, axis=axes) / jnp.maximum(
+                    jnp.sum(m, axis=axes), 1.0)
+            else:
+                y = jnp.mean(x, axis=axes)
+        elif pt == PoolingType.PNORM:
+            p = float(conf.pnorm)
+            if m is not None:
+                x = x * m
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type {pt}")
+        return self.activation_fn()(y), state
+
+
 @register_layer_impl(L.SubsamplingLayer)
 class SubsamplingImpl(LayerImpl):
     def forward(self, params, x, state, *, train=False, rng=None, mask=None):

@@ -57,13 +57,24 @@ class MultiLayerNetwork:
         self.updater_state: Dict[str, Any] = {}
         self.updater_specs: List[UpdaterSpec] = []
         self.iteration_count = 0
-        self.score_value: float = float("nan")
+        self._score: Any = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}  # rnnTimeStep carries
         self._lr_scale_host = 1.0  # SCORE-policy decay, adjusted host-side
         self._initialized = False
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
+
+    @property
+    def score_value(self) -> float:
+        """Most recent loss. Reading this blocks on the device; the train
+        loop stores the raw device scalar so steps pipeline without a
+        host-device sync per iteration."""
+        return float(self._score)
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score = v
 
     # ------------------------------------------------------------------
     # init (MultiLayerNetwork.init :343)
@@ -282,7 +293,7 @@ class MultiLayerNetwork:
                 rng, rnn_state,
             )
         )
-        self.score_value = float(loss)
+        self._score = loss  # device scalar; no sync (see score_value)
         return new_rnn
 
     def _solver_step(self, ds):
@@ -412,7 +423,7 @@ class MultiLayerNetwork:
             fm = lm = None
         val = self._score_fn(self.params, self.net_state, _dev(x), _dev(y),
                              _dev(fm), _dev(lm))
-        self.score_value = float(val)
+        self._score = val
         return self.score_value
 
     def score_examples(self, ds):

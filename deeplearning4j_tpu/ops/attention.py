@@ -1,0 +1,72 @@
+"""Scaled dot-product / multi-head attention ops.
+
+Greenfield relative to the reference (a pre-transformer codebase — SURVEY §5:
+"No attention of any kind exists"), but the long-context stack (ring
+attention, transformer blocks) builds on these primitives.
+
+Layouts: q/k/v are [batch, time, heads, head_dim] ("BTHD"); attention
+contracts over time with optional causal and padding masks. Inside jit the
+whole thing fuses; the Pallas flash kernel (pallas/flash_attention.py) is the
+memory-optimal path for long sequences on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,  # [b, t_kv] padding mask (1=keep)
+    bias: Optional[jnp.ndarray] = None,  # [b, h, t_q, t_kv] additive
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Reference (non-blockwise) attention: softmax(q·kᵀ/√d + bias)·v.
+
+    q: [b, tq, h, d]; k/v: [b, tkv, h, d] → [b, tq, h, d].
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        tq, tkv = q.shape[1], k.shape[1]
+        # allow tq != tkv (e.g. blockwise): positions are absolute offsets
+        qi = jnp.arange(tq)[:, None]
+        ki = jnp.arange(tkv)[None, :]
+        logits = jnp.where(qi >= ki, logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :].astype(bool), logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def multi_head_attention(
+    x: jnp.ndarray,
+    wq: jnp.ndarray,
+    wk: jnp.ndarray,
+    wv: jnp.ndarray,
+    wo: jnp.ndarray,
+    *,
+    num_heads: int,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Full MHA block: project → attend → merge. x: [b, t, f]."""
+    b, t, f = x.shape
+    d = wq.shape[-1] // num_heads
+    q = (x @ wq).reshape(b, t, num_heads, d)
+    k = (x @ wk).reshape(b, t, num_heads, d)
+    v = (x @ wv).reshape(b, t, num_heads, d)
+    o = dot_product_attention(q, k, v, causal=causal, mask=mask)
+    return o.reshape(b, t, num_heads * d) @ wo

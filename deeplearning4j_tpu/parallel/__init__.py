@@ -1,0 +1,32 @@
+"""Distributed training over jax.sharding meshes.
+
+This package replaces the ENTIRE ``deeplearning4j-scaleout`` tree (Spark
+parameter averaging, Akka actor parameter server, YARN iterative reduce,
+Hazelcast state tracking — SURVEY §2.5/§3.3/§3.4) with the TPU-native
+model: one jitted SPMD program over a ``jax.sharding.Mesh``, XLA inserting
+all-reduce/all-gather collectives over ICI — plus the greenfield
+parallelisms the reference never had (tensor parallel, sequence/context
+parallel ring attention).
+
+Modes:
+- ``ParallelWrapper`` (data_parallel.py) — synchronous DP: batch sharded over
+  the ``data`` axis, gradients all-reduced by GSPMD. The drop-in functional
+  replacement for SparkDl4jMultiLayer.fitDataSet.
+- ``ParameterAveragingTrainer`` (data_parallel.py) — exact parameter-averaging
+  semantics (independent local fits, periodic averaging) for parity with the
+  reference's Spark/Akka mode, expressed as a vmapped local-SGD step.
+- ``TensorParallel`` sharding rules (tensor_parallel.py) — param/activation
+  PartitionSpecs over a ``model`` axis.
+- ``ring_attention`` (ring_attention.py) — context parallelism over a
+  ``sequence`` axis via shard_map + ppermute.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    local_device_count,
+)
+from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
+    ParallelWrapper,
+    ParameterAveragingTrainer,
+)

@@ -1,0 +1,96 @@
+"""Mesh construction helpers.
+
+Axis-name conventions used across the framework (the scaling-book
+vocabulary):
+- ``data``     — batch (data parallel; gradient all-reduce rides ICI)
+- ``model``    — tensor parallel (sharded GEMMs)
+- ``sequence`` — context parallel (ring attention)
+- ``pipe``     — pipeline stages
+- ``expert``   — expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; -1 means 'all remaining devices'."""
+
+    data: int = -1
+    model: int = 1
+    sequence: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[Tuple[str, int], ...]:
+        fixed = {
+            MODEL_AXIS: self.model,
+            SEQUENCE_AXIS: self.sequence,
+            PIPE_AXIS: self.pipe,
+            EXPERT_AXIS: self.expert,
+        }
+        known = 1
+        for v in fixed.values():
+            known *= v
+        data = self.data
+        if data == -1:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}")
+            data = n_devices // known
+        total = data * known
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {data}x{known} != device count {n_devices}")
+        axes = [(DATA_AXIS, data)]
+        for name, size in fixed.items():
+            if size > 1:
+                axes.append((name, size))
+        return tuple(axes)
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Axes of size 1 are dropped, so a pure-DP mesh is 1-D ("data",) and a
+    DP×TP mesh is 2-D ("data", "model"). Device order follows
+    ``jax.devices()``, which on TPU enumerates chips so that adjacent ids
+    share ICI links — keeping the innermost mesh axis on the fastest
+    interconnect, per the GSPMD model.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    axes = spec.resolve(len(devices))
+    names = tuple(n for n, _ in axes)
+    sizes = tuple(s for _, s in axes)
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard axis 0 (batch) over 'data'; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))

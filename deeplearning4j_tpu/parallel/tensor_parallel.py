@@ -1,0 +1,125 @@
+"""Tensor parallelism: Megatron-style sharded GEMMs over the ``model`` axis.
+
+Greenfield relative to the reference (SURVEY §2.5: "NOT present in the
+reference: tensor/model parallelism"), but required of a modern TPU
+framework. Expressed as sharding *rules* over the same network abstraction —
+not a separate runtime: params get NamedShardings; GSPMD partitions the
+jitted train step and inserts the all-reduces.
+
+Scheme: alternating column/row parallelism for stacked dense-like layers —
+layer 2k's W is column-sharded P(None, "model") (output features split, no
+communication on the forward GEMM), layer 2k+1's W is row-sharded
+P("model", None) (contracting dim split, one psum after) — the classic
+two-GEMM pattern that needs a single all-reduce per pair. Recurrent layers
+column-shard the gate dimension; embedding tables row-shard the vocab.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+def _dense_spec(column: bool) -> Dict[str, P]:
+    if column:
+        return {"W": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)}
+    return {"W": P(MODEL_AXIS, None), "b": P()}
+
+
+def _lstm_spec() -> Dict[str, P]:
+    # gate dim (4n) column-sharded; recurrence contracts the replicated n
+    return {"W": P(None, MODEL_AXIS), "RW": P(None, MODEL_AXIS),
+            "b": P(MODEL_AXIS), "pI": P(MODEL_AXIS), "pF": P(MODEL_AXIS),
+            "pO": P(MODEL_AXIS)}
+
+
+def param_specs_for_network(conf) -> Dict[str, Any]:
+    """PartitionSpec tree matching a MultiLayerConfiguration's param tree."""
+    specs: Dict[str, Any] = {}
+    dense_count = 0
+    for i, lc in enumerate(conf.layers):
+        si = str(i)
+        if isinstance(lc, (L.DenseLayer, L.OutputLayer, L.AutoEncoder)):
+            # Output layers stay replicated: their n_out is the class count,
+            # usually tiny and followed by a softmax over the full axis.
+            if isinstance(lc, L.OutputLayer):
+                specs[si] = {k: P() for k in ("W", "b")}
+                if isinstance(lc, L.AutoEncoder):
+                    specs[si]["vb"] = P()
+                continue
+            specs[si] = _dense_spec(column=(dense_count % 2 == 0))
+            if isinstance(lc, L.AutoEncoder):
+                specs[si]["vb"] = P()
+            dense_count += 1
+        elif isinstance(lc, (L.GravesLSTM, L.LSTM)):
+            specs[si] = _lstm_spec()
+        elif isinstance(lc, L.GravesBidirectionalLSTM):
+            specs[si] = {"fwd": _lstm_spec(), "bwd": _lstm_spec()}
+        elif isinstance(lc, L.GRU):
+            specs[si] = {"W": P(None, MODEL_AXIS), "RW": P(None, MODEL_AXIS),
+                         "b": P(MODEL_AXIS)}
+        elif isinstance(lc, L.EmbeddingLayer):
+            specs[si] = {"W": P(MODEL_AXIS, None), "b": P()}
+        elif isinstance(lc, L.ConvolutionLayer):
+            # channels-out sharded: each model shard computes a slice of
+            # output feature maps
+            specs[si] = {"W": P(None, None, None, MODEL_AXIS), "b": P(MODEL_AXIS)}
+        else:
+            specs[si] = _replicated_like_layer(lc)
+    return specs
+
+
+def _replicated_like_layer(lc) -> Any:
+    return _ReplicateAll()
+
+
+class _ReplicateAll:
+    """Sentinel: replicate every leaf of this layer's params."""
+
+
+def shard_network_params(network, mesh: Mesh,
+                         specs: Optional[Dict[str, Any]] = None) -> None:
+    """device_put the network's params (and mirrored updater state) with
+    tensor-parallel NamedShardings. The subsequent jitted train step is then
+    partitioned by GSPMD along those shardings."""
+    network._ensure_init()
+    specs = specs or param_specs_for_network(network.conf)
+
+    def place(tree, spec):
+        if isinstance(spec, _ReplicateAll):
+            return jax.device_put(tree, NamedSharding(mesh, P()))
+        if isinstance(tree, dict):
+            return {k: place(v, spec[k] if isinstance(spec, dict) and k in spec else P())
+                    for k, v in tree.items()}
+        return jax.device_put(tree, NamedSharding(mesh, spec))
+
+    network.params = {
+        si: place(sub, specs.get(si, _ReplicateAll()))
+        for si, sub in network.params.items()
+    }
+
+    def place_state(tree, spec):
+        # updater state mirrors param shapes (possibly nested one level for
+        # adam {m, v}); shard each leaf like its param
+        if isinstance(tree, dict):
+            return {k: place_state(v, spec[k] if isinstance(spec, dict) and k in spec else spec)
+                    for k, v in tree.items()}
+        if tree.ndim == 0 or tree.size == 0:
+            return jax.device_put(tree, NamedSharding(mesh, P()))
+        if isinstance(spec, (_ReplicateAll,)) or spec is None:
+            return jax.device_put(tree, NamedSharding(mesh, P()))
+        if len(spec) == tree.ndim:
+            return jax.device_put(tree, NamedSharding(mesh, spec))
+        return jax.device_put(tree, NamedSharding(mesh, P()))
+
+    network.updater_state = {
+        si: place_state(sub, specs.get(si, _ReplicateAll()))
+        for si, sub in network.updater_state.items()
+    }
+    network.net_state = jax.device_put(
+        network.net_state, NamedSharding(mesh, P()))

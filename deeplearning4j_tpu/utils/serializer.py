@@ -133,18 +133,43 @@ class ModelSerializer:
 
     @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
+        return ModelSerializer._restore(path, load_updater,
+                                        expect="MultiLayerNetwork")
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        return ModelSerializer._restore(path, load_updater,
+                                        expect="ComputationGraph")
+
+    @staticmethod
+    def restore(path: str, load_updater: bool = True):
+        """Type-dispatching restore (single archive open)."""
+        return ModelSerializer._restore(path, load_updater, expect=None)
+
+    @staticmethod
+    def _restore(path: str, load_updater: bool, expect):
+        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
         from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         with zipfile.ZipFile(path, "r") as zf:
             meta = json.loads(zf.read("metadata.json"))
-            if meta["model_type"] != "MultiLayerNetwork":
-                raise TypeError(
-                    f"checkpoint holds a {meta['model_type']}, "
-                    "use restore_computation_graph")
-            conf = MultiLayerConfiguration.from_json(
-                zf.read("configuration.json").decode())
-            net = MultiLayerNetwork(conf).init()
+            mtype = meta.get("model_type")
+            if mtype not in ("MultiLayerNetwork", "ComputationGraph"):
+                raise ValueError(
+                    f"unknown model_type {mtype!r} in checkpoint metadata")
+            if expect is not None and mtype != expect:
+                other = ("restore_computation_graph" if mtype == "ComputationGraph"
+                         else "restore_multi_layer_network")
+                raise TypeError(f"checkpoint holds a {mtype}, use {other}")
+            conf_json = zf.read("configuration.json").decode()
+            if mtype == "MultiLayerNetwork":
+                net = MultiLayerNetwork(
+                    MultiLayerConfiguration.from_json(conf_json)).init()
+            else:
+                net = ComputationGraph(
+                    ComputationGraphConfiguration.from_json(conf_json)).init()
             net.params = _merge_into(net.params, _read_npz(zf, "coefficients.npz"))
             if load_updater and "updater.npz" in zf.namelist():
                 net.updater_state = _merge_into(
@@ -153,38 +178,3 @@ class ModelSerializer:
                 net.net_state = _merge_into(net.net_state, _read_npz(zf, "state.npz"))
             net.iteration_count = meta.get("iteration_count", 0)
         return net
-
-    @staticmethod
-    def restore_computation_graph(path: str, load_updater: bool = True):
-        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
-        from deeplearning4j_tpu.nn.graph import ComputationGraph
-
-        with zipfile.ZipFile(path, "r") as zf:
-            meta = json.loads(zf.read("metadata.json"))
-            if meta["model_type"] != "ComputationGraph":
-                raise TypeError(
-                    f"checkpoint holds a {meta['model_type']}, "
-                    "use restore_multi_layer_network")
-            conf = ComputationGraphConfiguration.from_json(
-                zf.read("configuration.json").decode())
-            net = ComputationGraph(conf).init()
-            net.params = _merge_into(net.params, _read_npz(zf, "coefficients.npz"))
-            if load_updater and "updater.npz" in zf.namelist():
-                net.updater_state = _merge_into(
-                    net.updater_state, _read_npz(zf, "updater.npz"))
-            if "state.npz" in zf.namelist():
-                net.net_state = _merge_into(net.net_state, _read_npz(zf, "state.npz"))
-            net.iteration_count = meta.get("iteration_count", 0)
-        return net
-
-    @staticmethod
-    def restore(path: str, load_updater: bool = True):
-        """Type-dispatching restore."""
-        with zipfile.ZipFile(path, "r") as zf:
-            meta = json.loads(zf.read("metadata.json"))
-        mtype = meta.get("model_type")
-        if mtype == "MultiLayerNetwork":
-            return ModelSerializer.restore_multi_layer_network(path, load_updater)
-        if mtype == "ComputationGraph":
-            return ModelSerializer.restore_computation_graph(path, load_updater)
-        raise ValueError(f"unknown model_type {mtype!r} in checkpoint metadata")

@@ -1,0 +1,249 @@
+"""Dataset fetchers + canonical iterators (MNIST / Iris / CIFAR / Curves).
+
+Mirror of ``datasets/fetchers/`` + ``datasets/iterator/impl/`` in the
+reference (MnistDataFetcher + MnistDataSetIterator with the idx-file binary
+parsers in datasets/mnist/, IrisDataFetcher, CifarDataSetIterator,
+CurvesDataFetcher).
+
+Zero-egress policy: the reference's fetchers download on demand
+(base/MnistFetcher.java). Here each fetcher first looks for local files
+(``DL4J_TPU_DATA_DIR``, default ``~/.deeplearning4j_tpu``); when absent it
+falls back to a DETERMINISTIC synthetic surrogate with the same shapes and
+label structure, so pipelines/tests/benchmarks run identically with or
+without the real data. ``is_synthetic`` reports which one you got.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import BaseDataSetIterator
+
+
+def data_dir() -> str:
+    return os.environ.get(
+        "DL4J_TPU_DATA_DIR",
+        os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
+
+
+# ---------------------------------------------------------------------------
+# MNIST idx parsing (datasets/mnist/MnistImageFile|MnistDbFile equivalents)
+# ---------------------------------------------------------------------------
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad idx image magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols, 1).astype(np.float32) / 255.0
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad idx label magic {magic}")
+        return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+
+
+class BaseDataFetcher:
+    """Cursor-based fetcher protocol (datasets/fetchers/BaseDataFetcher)."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray,
+                 num_classes: int, synthetic: bool):
+        self.features = features
+        self.labels = labels
+        self.num_classes = num_classes
+        self.is_synthetic = synthetic
+
+    def total_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def fetch(self, start: int, num: int) -> DataSet:
+        x = self.features[start:start + num]
+        y = np.eye(self.num_classes, dtype=np.float32)[
+            self.labels[start:start + num]]
+        return DataSet(x, y)
+
+    def input_columns(self) -> int:
+        return int(np.prod(self.features.shape[1:]))
+
+    def total_outcomes(self) -> int:
+        return self.num_classes
+
+
+class MnistDataFetcher(BaseDataFetcher):
+    """MNIST from local idx files, or a deterministic synthetic surrogate
+    (digit-dependent gaussian blobs over 28x28) when absent."""
+
+    FILES = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, train: bool = True, binarize: bool = False,
+                 flatten: bool = True, num_examples: Optional[int] = None,
+                 seed: int = 123):
+        img_name, lbl_name = self.FILES[train]
+        base = os.path.join(data_dir(), "mnist")
+        img_path = _first_existing(base, img_name)
+        synthetic = img_path is None
+        if not synthetic:
+            x = _read_idx_images(img_path)
+            y = _read_idx_labels(_first_existing(base, lbl_name))
+        else:
+            n = num_examples or (60000 if train else 10000)
+            n = min(n, 10000)  # keep the synthetic surrogate small
+            x, y = _synthetic_mnist(n, seed + (0 if train else 1))
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        if binarize:
+            x = (x > 0.5).astype(np.float32)
+        if flatten:
+            x = x.reshape(x.shape[0], -1)
+        super().__init__(x, y, 10, synthetic)
+
+
+def _first_existing(base: str, name: str) -> Optional[str]:
+    for candidate in (os.path.join(base, name),
+                      os.path.join(base, name + ".gz")):
+        if os.path.exists(candidate):
+            return candidate
+    return None
+
+
+def _synthetic_mnist(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Digit-dependent blob images: class-separable, deterministic."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    x = np.zeros((n, 28, 28, 1), np.float32)
+    yy, xx = np.mgrid[0:28, 0:28]
+    for digit in range(10):
+        idx = np.where(y == digit)[0]
+        if idx.size == 0:
+            continue
+        cy, cx = 7 + 2 * (digit // 5), 5 + 4 * (digit % 5)
+        blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0))
+        noise = rng.random((idx.size, 28, 28)).astype(np.float32) * 0.2
+        x[idx, :, :, 0] = np.clip(blob[None] + noise, 0, 1)
+    return x, y
+
+
+class IrisDataFetcher(BaseDataFetcher):
+    """Iris from a local CSV (sepal/petal cols + class index), else a
+    deterministic 3-cluster synthetic with iris-like feature scales."""
+
+    def __init__(self, seed: int = 6):
+        path = os.path.join(data_dir(), "iris", "iris.csv")
+        if os.path.exists(path):
+            raw = np.loadtxt(path, delimiter=",")
+            x = raw[:, :4].astype(np.float32)
+            y = raw[:, 4].astype(np.int64)
+            synthetic = False
+        else:
+            rng = np.random.default_rng(seed)
+            centers = np.asarray([[5.0, 3.4, 1.5, 0.2],
+                                  [5.9, 2.8, 4.3, 1.3],
+                                  [6.6, 3.0, 5.6, 2.0]], np.float32)
+            scales = np.asarray([[0.35, 0.38, 0.17, 0.10],
+                                 [0.52, 0.31, 0.47, 0.20],
+                                 [0.64, 0.32, 0.55, 0.27]], np.float32)
+            y = np.repeat(np.arange(3), 50)
+            x = (centers[y] + rng.normal(size=(150, 4)).astype(np.float32)
+                 * scales[y])
+            synthetic = True
+        super().__init__(x, y, 3, synthetic)
+
+
+class CifarDataFetcher(BaseDataFetcher):
+    """CIFAR-10 from local binary batches, else synthetic 32x32x3 blobs."""
+
+    def __init__(self, train: bool = True, num_examples: Optional[int] = None,
+                 seed: int = 77):
+        base = os.path.join(data_dir(), "cifar-10-batches-bin")
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        paths = [os.path.join(base, f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            xs, ys = [], []
+            for p in paths:
+                raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+                ys.append(raw[:, 0].astype(np.int64))
+                # stored CHW planar → NHWC
+                imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                xs.append(imgs.astype(np.float32) / 255.0)
+            x, y = np.concatenate(xs), np.concatenate(ys)
+            synthetic = False
+        else:
+            n = num_examples or (2000 if train else 500)
+            rng = np.random.default_rng(seed + (0 if train else 1))
+            y = rng.integers(0, 10, n)
+            x = (rng.random((n, 32, 32, 3)).astype(np.float32) * 0.3
+                 + (y[:, None, None, None] / 10.0))
+            synthetic = True
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        super().__init__(x, y, 10, synthetic)
+
+
+class CurvesDataFetcher(BaseDataFetcher):
+    """Synthetic 'curves' autoencoder dataset (CurvesDataFetcher role):
+    smooth random 1-D curves rasterized to vectors; labels = curve family."""
+
+    def __init__(self, num_examples: int = 2000, dim: int = 784,
+                 seed: int = 99):
+        rng = np.random.default_rng(seed)
+        t = np.linspace(0, 1, dim, dtype=np.float32)
+        y = rng.integers(0, 4, num_examples)
+        freq = 1.0 + y.astype(np.float32)
+        phase = rng.random(num_examples).astype(np.float32) * 2 * np.pi
+        x = 0.5 + 0.5 * np.sin(2 * np.pi * freq[:, None] * t[None]
+                               + phase[:, None])
+        super().__init__(x.astype(np.float32), y, 4, True)
+
+
+# ---------------------------------------------------------------------------
+# canonical iterators (datasets/iterator/impl/)
+# ---------------------------------------------------------------------------
+
+
+class MnistDataSetIterator(BaseDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True, binarize: bool = False,
+                 flatten: bool = True, seed: int = 123):
+        fetcher = MnistDataFetcher(train=train, binarize=binarize,
+                                   flatten=flatten, num_examples=num_examples,
+                                   seed=seed)
+        super().__init__(batch_size, num_examples or fetcher.total_examples(),
+                         fetcher)
+
+
+class IrisDataSetIterator(BaseDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: int = 150):
+        fetcher = IrisDataFetcher()
+        super().__init__(batch_size, min(num_examples, fetcher.total_examples()),
+                         fetcher)
+
+
+class CifarDataSetIterator(BaseDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True):
+        fetcher = CifarDataFetcher(train=train, num_examples=num_examples)
+        super().__init__(batch_size, num_examples or fetcher.total_examples(),
+                         fetcher)
+
+
+class CurvesDataSetIterator(BaseDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: int = 2000):
+        fetcher = CurvesDataFetcher(num_examples=num_examples)
+        super().__init__(batch_size, num_examples, fetcher)

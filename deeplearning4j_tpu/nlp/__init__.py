@@ -1,0 +1,26 @@
+"""NLP / embeddings: word2vec, GloVe, paragraph vectors, tokenization.
+
+Re-design of ``deeplearning4j-nlp`` (SURVEY §2.4, 33k LoC). The reference
+trains embeddings with Hogwild CPU threads doing per-word-pair BLAS-1 updates
+on shared arrays (SequenceVectors.java:166-195, SkipGram.iterateSample:160).
+The TPU-first equivalent: the host builds BATCHES of (center, context,
+negative) index arrays; one jitted device step gathers embedding rows,
+computes the skip-gram/CBOW objective, and applies sparse updates via
+segment-sum scatter — thousands of word pairs per step on the MXU instead of
+one pair per thread.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401

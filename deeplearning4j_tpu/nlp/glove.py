@@ -1,0 +1,193 @@
+"""GloVe: windowed co-occurrence counting + batched AdaGrad WLS on device.
+
+Mirror of models/glove/ (Glove.java:413, AbstractCoOccurrences.java:624
+windowed counting with disk spill, GloveWeightLookupTable AdaGrad updates).
+Counting stays host-side (hash map; the corpus scan is IO-bound); the
+weighted-least-squares updates run as one jitted AdaGrad step per shuffled
+batch of (i, j, X_ij) triples.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
+    """AdaGrad step on J = Σ f(x)(w_i·w̃_j + b_i + b̃_j − log x)²."""
+    wi = w[rows]
+    wj = wc[cols]
+    diff = jnp.sum(wi * wj, axis=-1) + b[rows] + bc[cols] - logx  # [B]
+    loss = jnp.mean(fx * diff * diff)
+    g = fx * diff                                                # [B]
+    gwi = g[:, None] * wj
+    gwj = g[:, None] * wi
+    # AdaGrad accumulators (per-row history, gathered then scattered back)
+    hw = hw.at[rows].add(gwi * gwi)
+    hwc = hwc.at[cols].add(gwj * gwj)
+    hb = hb.at[rows].add(g * g)
+    hbc = hbc.at[cols].add(g * g)
+    w = w.at[rows].add(-lr * gwi / (jnp.sqrt(hw[rows]) + 1e-8))
+    wc = wc.at[cols].add(-lr * gwj / (jnp.sqrt(hwc[cols]) + 1e-8))
+    b = b.at[rows].add(-lr * g / (jnp.sqrt(hb[rows]) + 1e-8))
+    bc = bc.at[cols].add(-lr * g / (jnp.sqrt(hbc[cols]) + 1e-8))
+    return w, wc, b, bc, hw, hwc, hb, hbc, loss
+
+
+class Glove:
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def iterate(self, it: SentenceIterator):
+            self._kw["sentence_iterator"] = it
+            return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._kw["tokenizer_factory"] = tf
+            return self
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = int(v)
+            return self
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = int(v)
+            return self
+
+        def window_size(self, v):
+            self._kw["window_size"] = int(v)
+            return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v)
+            return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = int(v)
+            return self
+
+        def x_max(self, v):
+            self._kw["x_max"] = float(v)
+            return self
+
+        def alpha(self, v):
+            self._kw["alpha"] = float(v)
+            return self
+
+        def seed(self, v):
+            self._kw["seed"] = int(v)
+            return self
+
+        def build(self) -> "Glove":
+            return Glove(**self._kw)
+
+    def __init__(self, sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 1, layer_size: int = 50,
+                 window_size: int = 5, learning_rate: float = 0.05,
+                 epochs: int = 20, x_max: float = 100.0, alpha: float = 0.75,
+                 batch_size: int = 16384, seed: int = 42):
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None  # w + wc merged after fit
+        self._rng = np.random.default_rng(seed)
+
+    def _sentences_tokens(self):
+        self.sentence_iterator.reset()
+        for s in self.sentence_iterator:
+            yield self.tokenizer_factory.create(s).get_tokens()
+
+    def count_cooccurrences(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Windowed, distance-weighted counts (AbstractCoOccurrences)."""
+        counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        for tokens in self._sentences_tokens():
+            idx = [self.vocab.index_of(t) for t in tokens]
+            idx = [i for i in idx if i >= 0]
+            for i, wi in enumerate(idx):
+                for off in range(1, self.window_size + 1):
+                    j = i + off
+                    if j >= len(idx):
+                        break
+                    weight = 1.0 / off
+                    counts[(wi, idx[j])] += weight
+                    counts[(idx[j], wi)] += weight
+        rows = np.asarray([k[0] for k in counts], np.int32)
+        cols = np.asarray([k[1] for k in counts], np.int32)
+        x = np.asarray(list(counts.values()), np.float32)
+        return rows, cols, x
+
+    def fit(self) -> "Glove":
+        if self.vocab is None:
+            self.vocab = build_vocab(self._sentences_tokens(),
+                                     self.min_word_frequency)
+        rows, cols, x = self.count_cooccurrences()
+        n, d = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        k1, k2 = jax.random.split(key)
+        scale = 0.5 / d
+        w = jax.random.uniform(k1, (n, d), jnp.float32, -scale, scale)
+        wc = jax.random.uniform(k2, (n, d), jnp.float32, -scale, scale)
+        b = jnp.zeros((n,), jnp.float32)
+        bc = jnp.zeros((n,), jnp.float32)
+        hw = jnp.full((n, d), 1e-8, jnp.float32)
+        hwc = jnp.full((n, d), 1e-8, jnp.float32)
+        hb = jnp.full((n,), 1e-8, jnp.float32)
+        hbc = jnp.full((n,), 1e-8, jnp.float32)
+        logx = np.log(x)
+        fx = np.minimum(1.0, (x / self.x_max) ** self.alpha).astype(np.float32)
+        for _ in range(self.epochs):
+            order = self._rng.permutation(len(rows))
+            for s in range(0, len(order), self.batch_size):
+                sel = order[s:s + self.batch_size]
+                (w, wc, b, bc, hw, hwc, hb, hbc, loss) = _glove_step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
+                    jnp.asarray(logx[sel]), jnp.asarray(fx[sel]),
+                    self.learning_rate)
+        self.syn0 = np.asarray(w) + np.asarray(wc)  # standard GloVe merge
+        self._loss = float(loss)
+        return self
+
+    # --- lookups (same surface as Word2Vec) ---
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word)
+        return None if idx < 0 else self.syn0[idx]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        return float(np.dot(a, b)
+                     / ((np.linalg.norm(a) + 1e-12) * (np.linalg.norm(b) + 1e-12)))
+
+    def words_nearest(self, word: str, top_n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        n = self.syn0 / (np.linalg.norm(self.syn0, axis=1, keepdims=True) + 1e-12)
+        sims = n @ (v / (np.linalg.norm(v) + 1e-12))
+        sims[self.vocab.index_of(word)] = -np.inf
+        return [self.vocab.word_at_index(int(i))
+                for i in np.argsort(-sims)[:top_n]]

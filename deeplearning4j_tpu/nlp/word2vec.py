@@ -1,0 +1,449 @@
+"""Word2Vec: batched device-parallel skip-gram / CBOW.
+
+Re-design of models/word2vec/Word2Vec.java:31 + SequenceVectors.java:48 +
+learning/impl/elements/SkipGram.java:24 (iterateSample :160 — per-pair
+hierarchical-softmax / negative-sampling row updates on shared syn0/syn1
+arrays from Hogwild threads).
+
+TPU-first execution model: the host walks the corpus emitting (center,
+context) index pairs with word2vec's reduced-window + frequent-word
+subsampling; pairs are batched (thousands at a time) and a single jitted
+step per batch does:
+  gather rows → σ(u·v) objectives (NEG or HS) → sparse updates via
+  ``.at[idx].add`` scatter (deterministic duplicate accumulation).
+This replaces lock-free racing threads with one deterministic SPMD program —
+same objective, device-scale batch parallelism instead of thread parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    Huffman,
+    VocabCache,
+    build_vocab,
+    unigram_table,
+)
+
+
+# ---------------------------------------------------------------------------
+# jitted update steps
+# ---------------------------------------------------------------------------
+
+
+def _row_scale(n_rows, idx):
+    """1/count-per-row scaling for scatter-adds: a row hit k times in one
+    batch receives the MEAN of its k per-pair updates rather than the sum.
+    Without this, small vocabs (row hit ~B/V times per batch) multiply the
+    effective learning rate by the hit count and diverge — the sequential
+    reference recomputes σ between pair updates, which bounds step size."""
+    counts = jnp.zeros((n_rows,), jnp.float32).at[idx.reshape(-1)].add(1.0)
+    return 1.0 / jnp.maximum(counts[idx], 1.0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _neg_sampling_step(syn0, syn1neg, centers, contexts, negatives, lr):
+    """Skip-gram with negative sampling, one batch of pairs.
+
+    centers/contexts: [B]; negatives: [B, K]; returns updated tables + loss.
+    """
+    h = syn0[centers]                      # [B, D]
+    v_pos = syn1neg[contexts]              # [B, D]
+    v_neg = syn1neg[negatives]             # [B, K, D]
+
+    s_pos = jax.nn.sigmoid(jnp.sum(h * v_pos, axis=-1))          # [B]
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, v_neg))   # [B, K]
+    loss = -jnp.mean(jnp.log(s_pos + 1e-10)
+                     + jnp.sum(jnp.log(1.0 - s_neg + 1e-10), axis=-1))
+
+    g_pos = (s_pos - 1.0) * lr             # [B]
+    g_neg = s_neg * lr                     # [B, K]
+
+    grad_h = (g_pos[:, None] * v_pos
+              + jnp.einsum("bk,bkd->bd", g_neg, v_neg))          # [B, D]
+    sc_c = _row_scale(syn0.shape[0], centers)
+    syn0 = syn0.at[centers].add(-grad_h * sc_c[:, None])
+    # contexts and negatives both scatter into syn1neg: count them jointly
+    joint = jnp.concatenate([contexts[:, None], negatives], axis=1)  # [B,1+K]
+    counts1 = jnp.zeros((syn1neg.shape[0],), jnp.float32).at[
+        joint.reshape(-1)].add(1.0)
+    sc_pos = 1.0 / jnp.maximum(counts1[contexts], 1.0)
+    sc_neg = 1.0 / jnp.maximum(counts1[negatives], 1.0)
+    syn1neg = syn1neg.at[contexts].add(-(g_pos * sc_pos)[:, None] * h)
+    syn1neg = syn1neg.at[negatives.reshape(-1)].add(
+        -((g_neg * sc_neg)[..., None] * h[:, None, :]).reshape(-1, h.shape[-1]))
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, points, codes, mask, lr):
+    """Skip-gram with hierarchical softmax.
+
+    points/codes/mask: [B, C] padded Huffman paths (mask 0 on padding).
+    Objective per node: label = 1 - code; maximize log σ((1-2·code)·u·v).
+    """
+    h = syn0[centers]                              # [B, D]
+    v = syn1[points]                               # [B, C, D]
+    u = jnp.einsum("bd,bcd->bc", h, v)             # [B, C]
+    s = jax.nn.sigmoid(u)
+    label = 1.0 - codes
+    loss = -jnp.sum(mask * jnp.log(jnp.abs(label - jax.nn.sigmoid(-u)) + 1e-10)) \
+        / jnp.maximum(jnp.sum(mask), 1.0)
+    g = (s - label) * mask * lr                    # [B, C]
+    grad_h = jnp.einsum("bc,bcd->bd", g, v)
+    sc_c = _row_scale(syn0.shape[0], centers)
+    syn0 = syn0.at[centers].add(-grad_h * sc_c[:, None])
+    # inner nodes near the root appear in nearly every path: normalize
+    counts1 = jnp.zeros((syn1.shape[0],), jnp.float32).at[
+        points.reshape(-1)].add(mask.reshape(-1))
+    sc_p = 1.0 / jnp.maximum(counts1[points], 1.0)
+    syn1 = syn1.at[points.reshape(-1)].add(
+        -((g * sc_p)[..., None] * h[:, None, :]).reshape(-1, h.shape[-1]))
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_neg_step(syn0, syn1neg, context_idx, context_mask, targets,
+                   negatives, lr):
+    """CBOW-NEG: mean of context rows predicts the target."""
+    ctx = syn0[context_idx]                            # [B, W, D]
+    m = context_mask[..., None]
+    denom = jnp.maximum(jnp.sum(context_mask, axis=-1, keepdims=True), 1.0)
+    h = jnp.sum(ctx * m, axis=1) / denom               # [B, D]
+    v_pos = syn1neg[targets]
+    v_neg = syn1neg[negatives]
+    s_pos = jax.nn.sigmoid(jnp.sum(h * v_pos, axis=-1))
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, v_neg))
+    loss = -jnp.mean(jnp.log(s_pos + 1e-10)
+                     + jnp.sum(jnp.log(1.0 - s_neg + 1e-10), axis=-1))
+    g_pos = (s_pos - 1.0) * lr
+    g_neg = s_neg * lr
+    grad_h = (g_pos[:, None] * v_pos
+              + jnp.einsum("bk,bkd->bd", g_neg, v_neg)) / denom
+    # distribute the mean-gradient onto each (unmasked) context row
+    counts0 = jnp.zeros((syn0.shape[0],), jnp.float32).at[
+        context_idx.reshape(-1)].add(context_mask.reshape(-1))
+    sc0 = (1.0 / jnp.maximum(counts0[context_idx], 1.0))[..., None]
+    upd = jnp.broadcast_to(grad_h[:, None, :], ctx.shape) * m * sc0
+    syn0 = syn0.at[context_idx.reshape(-1)].add(
+        -upd.reshape(-1, ctx.shape[-1]))
+    joint = jnp.concatenate([targets[:, None], negatives], axis=1)
+    counts1 = jnp.zeros((syn1neg.shape[0],), jnp.float32).at[
+        joint.reshape(-1)].add(1.0)
+    sc_pos = 1.0 / jnp.maximum(counts1[targets], 1.0)
+    sc_neg = 1.0 / jnp.maximum(counts1[negatives], 1.0)
+    syn1neg = syn1neg.at[targets].add(-(g_pos * sc_pos)[:, None] * h)
+    syn1neg = syn1neg.at[negatives.reshape(-1)].add(
+        -((g_neg * sc_neg)[..., None] * h[:, None, :]).reshape(-1, h.shape[-1]))
+    return syn0, syn1neg, loss
+
+
+# ---------------------------------------------------------------------------
+# Word2Vec
+# ---------------------------------------------------------------------------
+
+
+class Word2Vec:
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = int(v)
+            return self
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = int(v)
+            return self
+
+        def window_size(self, v):
+            self._kw["window_size"] = int(v)
+            return self
+
+        def negative_sample(self, v):
+            self._kw["negative"] = int(v)
+            return self
+
+        def use_hierarchic_softmax(self, b: bool):
+            self._kw["hierarchic_softmax"] = bool(b)
+            return self
+
+        def elements_learning_algorithm(self, name: str):
+            # "SkipGram" | "CBOW" (ElementsLearningAlgorithm SPI)
+            self._kw["algorithm"] = name.lower()
+            return self
+
+        def iterations(self, v):
+            self._kw["iterations"] = int(v)
+            return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = int(v)
+            return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v)
+            return self
+
+        def min_learning_rate(self, v):
+            self._kw["min_learning_rate"] = float(v)
+            return self
+
+        def sampling(self, v):
+            self._kw["sampling"] = float(v)
+            return self
+
+        def batch_size(self, v):
+            self._kw["batch_size"] = int(v)
+            return self
+
+        def seed(self, v):
+            self._kw["seed"] = int(v)
+            return self
+
+        def iterate(self, sentence_iterator: SentenceIterator):
+            self._kw["sentence_iterator"] = sentence_iterator
+            return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._kw["tokenizer_factory"] = tf
+            return self
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(**self._kw)
+
+    def __init__(self, sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 5, layer_size: int = 100,
+                 window_size: int = 5, negative: int = 5,
+                 hierarchic_softmax: bool = False, algorithm: str = "skipgram",
+                 iterations: int = 1, epochs: int = 1,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, sampling: float = 0.0,
+                 batch_size: int = 4096, seed: int = 42,
+                 table_size: int = 100_000):
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.negative = negative
+        self.hierarchic_softmax = hierarchic_softmax or negative == 0
+        self.algorithm = algorithm
+        self.iterations = iterations
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.sampling = sampling
+        self.batch_size = batch_size
+        self.seed = seed
+        self.table_size = table_size
+
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[jnp.ndarray] = None
+        self.syn1: Optional[jnp.ndarray] = None      # HS inner nodes
+        self.syn1neg: Optional[jnp.ndarray] = None   # NEG output table
+        self._table: Optional[np.ndarray] = None
+        self._rng = np.random.default_rng(seed)
+        self._norm_cache: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def _sentences_tokens(self) -> Iterable[List[str]]:
+        self.sentence_iterator.reset()
+        for sentence in self.sentence_iterator:
+            yield self.tokenizer_factory.create(sentence).get_tokens()
+
+    def build_vocab(self):
+        self.vocab = build_vocab(self._sentences_tokens(),
+                                 self.min_word_frequency)
+        if self.hierarchic_softmax:
+            Huffman(self.vocab).build()
+        else:
+            self._table = unigram_table(self.vocab, self.table_size)
+        return self
+
+    def reset_weights(self):
+        n, d = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        # word2vec init: U(-0.5/d, 0.5/d) for syn0, zeros for output tables
+        self.syn0 = (jax.random.uniform(key, (n, d), jnp.float32) - 0.5) / d
+        if self.hierarchic_softmax:
+            self.syn1 = jnp.zeros((max(n - 1, 1), d), jnp.float32)
+        else:
+            self.syn1neg = jnp.zeros((n, d), jnp.float32)
+        return self
+
+    # ------------------------------------------------------------------
+    def _corpus_indices(self) -> List[np.ndarray]:
+        """Sentences as filtered index arrays with frequent-word
+        subsampling (SkipGram's sampling logic)."""
+        out = []
+        total = max(self.vocab.total_word_count, 1)
+        for tokens in self._sentences_tokens():
+            idx = []
+            for t in tokens:
+                vw = self.vocab.word_for(t)
+                if vw is None:
+                    continue
+                if self.sampling > 0:
+                    f = vw.count / total
+                    keep = (np.sqrt(f / self.sampling) + 1) * self.sampling / f
+                    if self._rng.random() > keep:
+                        continue
+                idx.append(vw.index)
+            if len(idx) > 1:
+                out.append(np.asarray(idx, np.int32))
+        return out
+
+    def _emit_pairs(self, sentences: List[np.ndarray]
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(center, context) with word2vec's reduced window."""
+        centers, contexts = [], []
+        for s in sentences:
+            n = len(s)
+            windows = self._rng.integers(1, self.window_size + 1, n)
+            for i in range(n):
+                b = windows[i]
+                lo, hi = max(0, i - b), min(n, i + b + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(s[i])
+                        contexts.append(s[j])
+        return (np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    # ------------------------------------------------------------------
+    def fit(self) -> "Word2Vec":
+        if self.vocab is None:
+            self.build_vocab()
+        if self.syn0 is None:
+            self.reset_weights()
+        sentences = self._corpus_indices()
+        if self.hierarchic_softmax:
+            max_code = max((len(vw.codes) for vw in self.vocab.vocab_words()),
+                           default=1)
+            points_tbl = np.zeros((self.vocab.num_words(), max_code), np.int32)
+            codes_tbl = np.zeros((self.vocab.num_words(), max_code), np.float32)
+            mask_tbl = np.zeros((self.vocab.num_words(), max_code), np.float32)
+            for vw in self.vocab.vocab_words():
+                c = len(vw.codes)
+                points_tbl[vw.index, :c] = vw.points
+                codes_tbl[vw.index, :c] = vw.codes
+                mask_tbl[vw.index, :c] = 1.0
+
+        total_steps = 0
+        planned = max(1, self.epochs * self.iterations)
+        for epoch in range(self.epochs):
+            for _ in range(self.iterations):
+                centers, contexts = self._emit_pairs(sentences)
+                order = self._rng.permutation(len(centers))
+                centers, contexts = centers[order], contexts[order]
+                # tiny corpora: shrink the batch so each epoch still takes
+                # several steps (batched mean-updates need step count)
+                batch_size = min(self.batch_size, max(32, len(centers) // 8))
+                for start in range(0, len(centers), batch_size):
+                    frac = total_steps / max(1, planned * max(
+                        1, len(centers) // batch_size))
+                    lr = max(self.min_learning_rate,
+                             self.learning_rate * (1.0 - frac))
+                    c = centers[start:start + batch_size]
+                    x = contexts[start:start + batch_size]
+                    if self.hierarchic_softmax:
+                        self.syn0, self.syn1, loss = _hs_step(
+                            self.syn0, self.syn1, jnp.asarray(c),
+                            jnp.asarray(points_tbl[x]),
+                            jnp.asarray(codes_tbl[x]),
+                            jnp.asarray(mask_tbl[x]), lr)
+                    elif self.algorithm == "cbow":
+                        # reuse pairs as (target, single-context) CBOW
+                        negs = self._sample_negatives(len(c), x)
+                        self.syn0, self.syn1neg, loss = _cbow_neg_step(
+                            self.syn0, self.syn1neg,
+                            jnp.asarray(x[:, None]),
+                            jnp.ones((len(x), 1), jnp.float32),
+                            jnp.asarray(c), jnp.asarray(negs), lr)
+                    else:
+                        negs = self._sample_negatives(len(c), x)
+                        self.syn0, self.syn1neg, loss = _neg_sampling_step(
+                            self.syn0, self.syn1neg, jnp.asarray(c),
+                            jnp.asarray(x), jnp.asarray(negs), lr)
+                    total_steps += 1
+        self._norm_cache = None
+        return self
+
+    def _sample_negatives(self, b: int, positives: np.ndarray) -> np.ndarray:
+        k = max(1, self.negative)
+        draws = self._table[self._rng.integers(0, len(self._table), (b, k))]
+        # resample collisions with the positive once (cheap approximation of
+        # the reference's redraw loop)
+        collide = draws == positives[:, None]
+        if collide.any():
+            redraws = self._table[self._rng.integers(0, len(self._table),
+                                                     collide.sum())]
+            draws[collide] = redraws
+        return draws.astype(np.int32)
+
+    # ------------------------------------------------------------------
+    # lookups (wordvectors/WordVectorsImpl + BasicModelUtils)
+    # ------------------------------------------------------------------
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word)
+        if idx < 0:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.has_token(word)
+
+    def _normed(self) -> np.ndarray:
+        if self._norm_cache is None:
+            m = np.asarray(self.syn0)
+            self._norm_cache = m / (np.linalg.norm(m, axis=1, keepdims=True)
+                                    + 1e-12)
+        return self._norm_cache
+
+    def similarity(self, w1: str, w2: str) -> float:
+        i, j = self.vocab.index_of(w1), self.vocab.index_of(w2)
+        if i < 0 or j < 0:
+            return float("nan")
+        n = self._normed()
+        return float(np.dot(n[i], n[j]))
+
+    def words_nearest(self, positive, negative=(), top_n: int = 10
+                      ) -> List[str]:
+        """Analogy-style nearest words (BasicModelUtils.wordsNearest)."""
+        if isinstance(positive, str):
+            positive = [positive]
+        n = self._normed()
+        query = np.zeros(self.layer_size, np.float32)
+        exclude = set()
+        for w in positive:
+            idx = self.vocab.index_of(w)
+            if idx >= 0:
+                query += n[idx]
+                exclude.add(idx)
+        for w in negative:
+            idx = self.vocab.index_of(w)
+            if idx >= 0:
+                query -= n[idx]
+                exclude.add(idx)
+        query /= (np.linalg.norm(query) + 1e-12)
+        sims = n @ query
+        for idx in exclude:
+            sims[idx] = -np.inf
+        top = np.argsort(-sims)[:top_n]
+        return [self.vocab.word_at_index(int(i)) for i in top]
+
+    def vocab_size(self) -> int:
+        return self.vocab.num_words() if self.vocab else 0

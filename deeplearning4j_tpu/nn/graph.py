@@ -368,6 +368,14 @@ class ComputationGraph:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
+    def clone(self) -> "ComputationGraph":
+        from deeplearning4j_tpu.nn.multilayer import copy_model_state
+
+        self._ensure_init()
+        other = ComputationGraph(self.conf.clone())
+        copy_model_state(self, other)
+        return other
+
     def get_param_table(self) -> Dict[str, np.ndarray]:
         self._ensure_init()
         from deeplearning4j_tpu.nn.multilayer import _named_leaves

@@ -524,13 +524,22 @@ class MultiLayerNetwork:
         self.listeners = list(listeners)
 
     def clone(self) -> "MultiLayerNetwork":
+        self._ensure_init()
         other = MultiLayerNetwork(self.conf.clone())
-        other.init()
-        other.params = jax.tree_util.tree_map(lambda a: a, self.params)
-        other.net_state = jax.tree_util.tree_map(lambda a: a, self.net_state)
-        other.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
-        other.iteration_count = self.iteration_count
+        copy_model_state(self, other)
         return other
+
+
+def copy_model_state(src, dst) -> None:
+    """Deep-copy trained state into a freshly-built network (shared by both
+    network classes' clone()). jnp.copy, not aliasing: the live net's train
+    step DONATES its buffers, which would delete aliased arrays out from
+    under the clone."""
+    dst.init()
+    dst.params = jax.tree_util.tree_map(jnp.copy, src.params)
+    dst.net_state = jax.tree_util.tree_map(jnp.copy, src.net_state)
+    dst.updater_state = jax.tree_util.tree_map(jnp.copy, src.updater_state)
+    dst.iteration_count = src.iteration_count
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,133 @@
+"""Early stopping tests (TestEarlyStopping.java analogues): termination
+reasons, best-model tracking, saver round-trip."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def toy(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, 6)) * 3
+    ys = rng.integers(0, 3, n)
+    x = (centers[ys] + rng.normal(size=(n, 6))).astype(np.float32)
+    return DataSet(x, np.eye(3)[ys].astype(np.float32))
+
+
+def net(lr=0.05):
+    conf = (NeuralNetConfiguration.Builder().seed(1).learning_rate(lr)
+            .updater(Updater.ADAM).list()
+            .layer(0, L.DenseLayer(n_in=6, n_out=12, activation="relu"))
+            .layer(1, L.OutputLayer(n_in=12, n_out=3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_max_epochs_termination():
+    ds = toy()
+    conf = (EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+            .score_calculator(DataSetLossCalculator(
+                ListDataSetIterator(toy(seed=1), 64)))
+            .build())
+    result = EarlyStoppingTrainer(conf, net(),
+                                  ListDataSetIterator(ds, 64)).fit()
+    assert result.termination_reason == EarlyStoppingResult.TerminationReason.EPOCH_TERMINATION
+    assert result.total_epochs == 5
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)
+
+
+def test_score_improvement_patience():
+    ds = toy()
+    # lr=0 → score never improves → patience trips after 2 stale epochs
+    conf = (EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(
+                MaxEpochsTerminationCondition(50),
+                ScoreImprovementEpochTerminationCondition(2))
+            .score_calculator(DataSetLossCalculator(
+                ListDataSetIterator(toy(seed=1), 64)))
+            .build())
+    result = EarlyStoppingTrainer(conf, net(lr=0.0),
+                                  ListDataSetIterator(ds, 64)).fit()
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs <= 5
+
+
+def test_divergence_guard():
+    ds = toy()
+    conf = (EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+            .iteration_termination_conditions(
+                MaxScoreIterationTerminationCondition(1e-12))
+            .score_calculator(DataSetLossCalculator(
+                ListDataSetIterator(toy(seed=1), 64)))
+            .build())
+    result = EarlyStoppingTrainer(conf, net(),
+                                  ListDataSetIterator(ds, 64)).fit()
+    assert result.termination_reason == EarlyStoppingResult.TerminationReason.ITERATION_TERMINATION
+    assert "MaxScore" in result.termination_details
+
+
+def test_time_guard_initializes():
+    cond = MaxTimeIterationTerminationCondition(1e9)
+    cond.initialize()
+    assert not cond.terminate(1.0)
+
+
+def test_invalid_score_condition():
+    cond = InvalidScoreIterationTerminationCondition()
+    assert cond.terminate(float("nan"))
+    assert cond.terminate(float("inf"))
+    assert not cond.terminate(1.0)
+
+
+def test_local_file_saver_roundtrip(tmp_path):
+    ds = toy()
+    saver = LocalFileModelSaver(str(tmp_path))
+    conf = (EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .model_saver(saver)
+            .score_calculator(DataSetLossCalculator(
+                ListDataSetIterator(toy(seed=1), 64)))
+            .build())
+    result = EarlyStoppingTrainer(conf, net(),
+                                  ListDataSetIterator(ds, 64)).fit()
+    best = result.get_best_model()
+    out = best.output(ds.features[:4])
+    assert out.shape == (4, 3)
+
+
+def test_best_model_is_frozen_copy():
+    """The saved best model must not track later (worse) training."""
+    ds = toy()
+    saver = InMemoryModelSaver()
+    conf = (EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+            .model_saver(saver)
+            .score_calculator(DataSetLossCalculator(
+                ListDataSetIterator(toy(seed=1), 64)))
+            .build())
+    trainer = EarlyStoppingTrainer(conf, net(), ListDataSetIterator(ds, 64))
+    result = trainer.fit()
+    best_params = result.best_model.get_flat_params()
+    trainer.network.fit(ds)  # keep training the live net
+    np.testing.assert_array_equal(best_params,
+                                  result.best_model.get_flat_params())

@@ -1,0 +1,233 @@
+"""NLP tests (Word2VecTests.java / GloveTest.java / ParagraphVectorsTest.java
+analogues): vocab/Huffman invariants, embedding semantics on a synthetic
+topic corpus, serializer round-trip, TF-IDF."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    DefaultTokenizerFactory,
+    Glove,
+    ParagraphVectors,
+    Word2Vec,
+)
+from deeplearning4j_tpu.nlp.bagofwords import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.sentence_iterator import LabelAwareSentenceIterator
+from deeplearning4j_tpu.nlp.serializer import (
+    load_binary,
+    load_txt_vectors,
+    load_word_vectors,
+    write_binary,
+    write_word_vectors,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import Huffman, build_vocab, unigram_table
+
+
+def topic_corpus(n_sentences=400, seed=0):
+    """Two disjoint topics; words within a topic co-occur."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep", "goat"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache", "bus"]
+    sentences = []
+    for _ in range(n_sentences):
+        topic = animals if rng.random() < 0.5 else tech
+        sentences.append(" ".join(rng.choice(topic, size=6)))
+    return sentences
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory()
+        assert tf.create("hello world foo").get_tokens() == ["hello", "world", "foo"]
+
+    def test_preprocessor(self):
+        tf = DefaultTokenizerFactory().set_token_pre_processor(CommonPreprocessor())
+        assert tf.create("Hello, World!").get_tokens() == ["hello", "world"]
+
+    def test_ngrams(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestVocab:
+    def test_build_and_filter(self):
+        vocab = build_vocab([["a", "a", "b"], ["a", "c"]], min_word_frequency=2)
+        assert vocab.has_token("a") and not vocab.has_token("b")
+        assert vocab.word_frequency("a") == 3
+        # most frequent word gets index 0
+        assert vocab.index_of("a") == 0
+
+    def test_huffman_invariants(self):
+        vocab = build_vocab([["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]])
+        Huffman(vocab).build()
+        words = vocab.vocab_words()
+        # frequent words get shorter codes
+        assert len(words[0].codes) <= len(words[-1].codes)
+        # points index inner nodes: < n-1
+        for vw in words:
+            assert (vw.points < vocab.num_words() - 1).all()
+            assert set(np.unique(vw.codes)).issubset({0, 1})
+
+    def test_unigram_table_distribution(self):
+        vocab = build_vocab([["a"] * 100 + ["b"]])
+        table = unigram_table(vocab, table_size=10000)
+        # 'a' (index 0) should dominate
+        assert (table == 0).mean() > 0.7
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("mode", ["neg", "hs"])
+    def test_topic_similarity(self, mode):
+        vec = (Word2Vec.Builder()
+               .iterate(CollectionSentenceIterator(topic_corpus()))
+               .min_word_frequency(1).layer_size(32).window_size(3)
+               .negative_sample(0 if mode == "hs" else 5)
+               .use_hierarchic_softmax(mode == "hs")
+               .epochs(8).seed(1).learning_rate(0.05)
+               .build())
+        vec.fit()
+        within = vec.similarity("cat", "dog")
+        across = vec.similarity("cat", "gpu")
+        assert within > across + 0.2, (mode, within, across)
+
+    def test_words_nearest(self):
+        vec = (Word2Vec.Builder()
+               .iterate(CollectionSentenceIterator(topic_corpus()))
+               .min_word_frequency(1).layer_size(32).epochs(8).seed(1)
+               .build())
+        vec.fit()
+        nearest = vec.words_nearest("cpu", top_n=3)
+        tech = {"gpu", "ram", "disk", "cache", "bus"}
+        assert len(tech.intersection(nearest)) >= 2, nearest
+
+    def test_unknown_word(self):
+        vec = (Word2Vec.Builder()
+               .iterate(CollectionSentenceIterator(["a b c d e f"] * 3))
+               .min_word_frequency(1).layer_size(8).epochs(1).build())
+        vec.fit()
+        assert vec.get_word_vector("zzz") is None
+        assert not vec.has_word("zzz")
+        assert np.isnan(vec.similarity("a", "zzz"))
+
+    def test_cbow_runs(self):
+        vec = (Word2Vec.Builder()
+               .iterate(CollectionSentenceIterator(topic_corpus(100)))
+               .elements_learning_algorithm("CBOW")
+               .min_word_frequency(1).layer_size(16).epochs(2).build())
+        vec.fit()
+        assert vec.vocab_size() == 12
+
+
+class TestSerializer:
+    def _small_model(self):
+        vec = (Word2Vec.Builder()
+               .iterate(CollectionSentenceIterator(topic_corpus(50)))
+               .min_word_frequency(1).layer_size(16).epochs(1).build())
+        return vec.fit()
+
+    def test_txt_roundtrip(self, tmp_path):
+        model = self._small_model()
+        path = str(tmp_path / "vecs.txt")
+        write_word_vectors(model, path)
+        vocab, syn0 = load_txt_vectors(path)
+        assert vocab.num_words() == model.vocab_size()
+        np.testing.assert_allclose(
+            syn0[vocab.index_of("cat")],
+            model.get_word_vector("cat"), atol=1e-5)
+
+    def test_binary_roundtrip(self, tmp_path):
+        model = self._small_model()
+        path = str(tmp_path / "vecs.bin")
+        write_binary(model, path)
+        vocab, syn0 = load_binary(path)
+        np.testing.assert_allclose(
+            syn0[vocab.index_of("dog")],
+            model.get_word_vector("dog"), atol=1e-6)
+
+    def test_loaded_model_lookup_surface(self, tmp_path):
+        model = self._small_model()
+        path = str(tmp_path / "vecs.txt")
+        write_word_vectors(model, path)
+        loaded = load_word_vectors(path)
+        assert loaded.similarity("cat", "cat") > 0.999
+        assert loaded.words_nearest("cat", top_n=2)
+
+
+class TestGlove:
+    def test_topic_similarity(self):
+        glove = (Glove.Builder()
+                 .iterate(CollectionSentenceIterator(topic_corpus()))
+                 .min_word_frequency(1).layer_size(16).window_size(3)
+                 .epochs(25).seed(1)
+                 .build())
+        glove.fit()
+        within = glove.similarity("cat", "dog")
+        across = glove.similarity("cat", "gpu")
+        assert within > across, (within, across)
+
+
+class TestParagraphVectors:
+    def test_label_vectors_cluster_by_topic(self):
+        rng = np.random.default_rng(0)
+        animals = ["cat dog horse cow", "dog sheep goat cat",
+                   "horse cow cat dog"]
+        tech = ["cpu gpu ram disk", "gpu cache bus cpu", "ram disk cpu gpu"]
+        sentences = animals + tech
+        labels = [f"A_{i}" for i in range(3)] + [f"T_{i}" for i in range(3)]
+        pv = (ParagraphVectors.Builder()
+              .iterate(LabelAwareSentenceIterator(sentences, labels))
+              .min_word_frequency(1).layer_size(24).epochs(60)
+              .learning_rate(0.05).seed(3)
+              .build())
+        pv.fit()
+        va = [pv.get_label_vector(f"A_{i}") for i in range(3)]
+        vt = [pv.get_label_vector(f"T_{i}") for i in range(3)]
+
+        def cos(a, b):
+            return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+        within = np.mean([cos(va[0], va[1]), cos(va[1], va[2]),
+                          cos(vt[0], vt[1]), cos(vt[1], vt[2])])
+        across = np.mean([cos(a, t) for a in va for t in vt])
+        assert within > across, (within, across)
+
+    def test_infer_and_predict(self):
+        sentences = ["cat dog horse cow"] * 3 + ["cpu gpu ram disk"] * 3
+        labels = [f"A_{i}" for i in range(3)] + [f"T_{i}" for i in range(3)]
+        pv = (ParagraphVectors.Builder()
+              .iterate(LabelAwareSentenceIterator(sentences, labels))
+              .min_word_frequency(1).layer_size(16).epochs(200).learning_rate(0.1).seed(3)
+              .build())
+        pv.fit()
+        assert pv.predict("cat dog cow").startswith("A_")
+        assert pv.predict("gpu cpu disk").startswith("T_")
+
+
+class TestVectorizers:
+    DOCS = ["the cat sat", "the dog ran", "cat and dog"]
+
+    def test_bow_counts(self):
+        v = BagOfWordsVectorizer().fit(self.DOCS)
+        x = v.transform("cat cat dog")
+        assert x[v.vocab.index_of("cat")] == 2.0
+        assert x[v.vocab.index_of("dog")] == 1.0
+
+    def test_tfidf_downweights_common(self):
+        v = TfidfVectorizer().fit(self.DOCS)
+        x = v.transform("the cat")
+        # 'the' appears in 2/3 docs, 'cat' in 2/3 — equal idf; use a rarer word
+        x2 = v.transform("sat cat")
+        assert x2[v.vocab.index_of("sat")] > x2[v.vocab.index_of("cat")]
+
+    def test_vectorize_dataset(self):
+        v = TfidfVectorizer().fit(self.DOCS)
+        ds = v.vectorize(self.DOCS, labels=[0, 1, 0], num_classes=2)
+        assert ds.features.shape == (3, v.vocab.num_words())
+        np.testing.assert_array_equal(ds.labels.sum(axis=1), [1, 1, 1])

@@ -1,0 +1,24 @@
+"""Clustering suite: k-means + spatial trees.
+
+Reference: deeplearning4j-core clustering/ (SURVEY §2.3) —
+``clustering/kmeans/KMeansClustering``, ``algorithm/BaseClusteringAlgorithm``
+(iteration strategy + convergence), spatial trees ``kdtree/KDTree``,
+``vptree/VPTree`` (NN search for the UI), ``quadtree/QuadTree``,
+``sptree/SpTree`` (Barnes-Hut).
+
+TPU-first split: k-means distance/assignment/update runs as one jitted XLA
+program per iteration (batched [n, k] distances on the MXU, segment-sum
+centroid update); the trees are host-side index structures serving
+Barnes-Hut t-SNE and nearest-neighbor queries.
+"""
+
+from .kmeans import KMeansClustering, Cluster, ClusterSet
+from .kdtree import KDTree
+from .vptree import VPTree
+from .quadtree import QuadTree
+from .sptree import SpTree
+
+__all__ = [
+    "KMeansClustering", "Cluster", "ClusterSet",
+    "KDTree", "VPTree", "QuadTree", "SpTree",
+]

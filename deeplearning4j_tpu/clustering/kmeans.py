@@ -1,0 +1,149 @@
+"""k-means clustering with device-batched iterations.
+
+Reference: ``clustering/kmeans/KMeansClustering.java`` +
+``clustering/algorithm/BaseClusteringAlgorithm.java:188`` (iteration strategy
+with convergence on cluster-assignment stability) and
+``clustering/cluster/ClusterUtils.java`` helpers.
+
+The reference loops point-by-point on the JVM; here one k-means iteration is
+a single XLA program: pairwise squared distances as a [n, k] matmul-shaped
+computation (MXU), argmin assignment, and ``jax.ops.segment_sum`` centroid
+update. Empty clusters keep their previous centroid (the reference respawns
+from the most-spread cluster; keeping the centroid is the standard
+fixed-point-compatible choice and is deterministic).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(2,), donate_argnums=())
+def _kmeans_step(points, centroids, distance: str):
+    """One assignment + update step. points [n, d], centroids [k, d]."""
+    if distance == "cosine":
+        pn = points / (jnp.linalg.norm(points, axis=1, keepdims=True) + 1e-12)
+        cn = centroids / (jnp.linalg.norm(centroids, axis=1, keepdims=True)
+                          + 1e-12)
+        dists = 1.0 - pn @ cn.T
+    elif distance == "manhattan":
+        dists = jnp.sum(jnp.abs(points[:, None, :] - centroids[None, :, :]),
+                        axis=-1)
+    else:  # euclidean: ||p||² - 2 p·c + ||c||² — rides the MXU via the GEMM
+        p2 = jnp.sum(points * points, axis=1, keepdims=True)
+        c2 = jnp.sum(centroids * centroids, axis=1)
+        dists = p2 - 2.0 * (points @ centroids.T) + c2[None, :]
+    assign = jnp.argmin(dists, axis=1)
+    k = centroids.shape[0]
+    sums = jax.ops.segment_sum(points, assign, num_segments=k)
+    counts = jax.ops.segment_sum(jnp.ones((points.shape[0],), points.dtype),
+                                 assign, num_segments=k)
+    new_centroids = jnp.where(counts[:, None] > 0,
+                              sums / jnp.maximum(counts[:, None], 1.0),
+                              centroids)
+    cost = jnp.sum(jnp.min(dists, axis=1))
+    return new_centroids, assign, cost
+
+
+@dataclass
+class Cluster:
+    """One cluster: centroid + member point indices (cluster/Cluster.java)."""
+    center: np.ndarray
+    point_indices: List[int] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.point_indices)
+
+
+@dataclass
+class ClusterSet:
+    """Result container (cluster/ClusterSet.java)."""
+    clusters: List[Cluster]
+    assignments: np.ndarray
+    cost: float
+
+    def nearest_cluster(self, point: np.ndarray) -> int:
+        centers = np.stack([c.center for c in self.clusters])
+        return int(np.argmin(np.sum((centers - point[None]) ** 2, axis=1)))
+
+
+class KMeansClustering:
+    """k-means with k-means++ init and assignment-stability convergence.
+
+    ``setup(k, max_iterations, distance)`` mirrors
+    ``KMeansClustering.setup(clusterCount, maxIterationCount, distanceFunction)``.
+    """
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 distance: str = "euclidean", seed: int = 123,
+                 tolerance: float = 1e-4):
+        if distance not in ("euclidean", "cosine", "manhattan"):
+            raise ValueError(f"unknown distance: {distance}")
+        if max_iterations < 1:
+            raise ValueError("max_iterations must be >= 1")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.distance = distance
+        self.seed = seed
+        self.tolerance = tolerance
+
+    @classmethod
+    def setup(cls, cluster_count: int, max_iteration_count: int,
+              distance_function: str = "euclidean", seed: int = 123,
+              tolerance: float = 1e-4) -> "KMeansClustering":
+        return cls(cluster_count, max_iteration_count, distance_function,
+                   seed, tolerance)
+
+    def _init_centroids(self, points: np.ndarray) -> np.ndarray:
+        """k-means++ seeding (host, O(nk))."""
+        rng = np.random.default_rng(self.seed)
+        n = points.shape[0]
+        centers = [points[rng.integers(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                np.stack([np.sum((points - c[None]) ** 2, axis=1)
+                          for c in centers]), axis=0)
+            total = d2.sum()
+            if total <= 0:
+                centers.append(points[rng.integers(n)])
+                continue
+            probs = d2 / total
+            centers.append(points[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def apply_to(self, points: np.ndarray) -> ClusterSet:
+        points = np.asarray(points, np.float32)
+        if points.shape[0] < self.k:
+            raise ValueError(
+                f"need at least k={self.k} points, got {points.shape[0]}")
+        centroids = jnp.asarray(self._init_centroids(points))
+        dev_points = jnp.asarray(points)
+        prev_assign: Optional[np.ndarray] = None
+        assign = None
+        cost = prev_cost = np.inf
+        for _ in range(self.max_iterations):
+            centroids, assign_dev, cost_dev = _kmeans_step(
+                dev_points, centroids, self.distance)
+            assign = np.asarray(assign_dev)
+            cost = float(cost_dev)
+            # converged when assignments are stable (the reference's
+            # criterion) or the cost improvement falls below tolerance
+            if prev_assign is not None and (
+                    np.array_equal(assign, prev_assign)
+                    or abs(prev_cost - cost)
+                    <= self.tolerance * max(abs(prev_cost), 1.0)):
+                break
+            prev_assign = assign
+            prev_cost = cost
+        centers = np.asarray(centroids)
+        clusters = [Cluster(center=centers[i]) for i in range(self.k)]
+        for idx, a in enumerate(assign):
+            clusters[int(a)].point_indices.append(idx)
+        return ClusterSet(clusters=clusters, assignments=assign, cost=cost)

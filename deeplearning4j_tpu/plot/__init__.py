@@ -1,0 +1,9 @@
+"""Visualization/dimensionality-reduction: t-SNE (exact device + Barnes-Hut).
+
+Reference: deeplearning4j-core ``plot/`` (SURVEY §2.3) —
+``BarnesHutTsne.java`` (796), ``Tsne.java`` (432 exact version).
+"""
+
+from .tsne import Tsne, BarnesHutTsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

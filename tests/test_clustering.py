@@ -1,0 +1,227 @@
+"""Clustering suite tests: k-means, KDTree, VPTree, QuadTree, SpTree, t-SNE.
+
+Models the reference's test approach (SURVEY §4): small synthetic fixtures,
+exact assertions against brute-force ground truth.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree, KMeansClustering, QuadTree, SpTree, VPTree)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def three_blobs(rng, n_per=40, d=4):
+    centers = np.array([[0.0] * d, [10.0] + [0.0] * (d - 1),
+                        [0.0, 10.0] + [0.0] * (d - 2)])
+    pts = np.concatenate([
+        c + rng.normal(0, 0.5, (n_per, d)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, rng):
+        pts, labels = three_blobs(rng)
+        cs = KMeansClustering.setup(3, 50).apply_to(pts)
+        assert cs.assignments.shape == (120,)
+        # each true blob maps to exactly one cluster
+        for c in range(3):
+            blob_assign = cs.assignments[labels == c]
+            assert len(np.unique(blob_assign)) == 1
+        # and clusters are distinct across blobs
+        reps = [cs.assignments[labels == c][0] for c in range(3)]
+        assert len(set(reps)) == 3
+
+    def test_cost_decreases_vs_random_centroids(self, rng):
+        pts, _ = three_blobs(rng)
+        cs = KMeansClustering(3, max_iterations=50).apply_to(pts)
+        one_iter = KMeansClustering(3, max_iterations=1).apply_to(pts)
+        assert cs.cost <= one_iter.cost + 1e-3
+
+    def test_cluster_membership_counts(self, rng):
+        pts, _ = three_blobs(rng)
+        cs = KMeansClustering(3, 50).apply_to(pts)
+        assert sum(c.count for c in cs.clusters) == 120
+
+    def test_cosine_distance(self, rng):
+        pts, _ = three_blobs(rng)
+        cs = KMeansClustering(3, 50, distance="cosine").apply_to(pts)
+        assert sum(c.count for c in cs.clusters) == 120
+
+    def test_nearest_cluster(self, rng):
+        pts, labels = three_blobs(rng)
+        cs = KMeansClustering(3, 50).apply_to(pts)
+        idx = cs.nearest_cluster(pts[0])
+        assert idx == cs.assignments[0]
+
+    def test_rejects_too_few_points(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(5, 10).apply_to(np.zeros((3, 2), np.float32))
+
+
+class TestTrees:
+    def test_kdtree_knn_matches_bruteforce(self, rng):
+        pts = rng.normal(0, 1, (200, 5))
+        tree = KDTree.build(pts)
+        q = rng.normal(0, 1, 5)
+        got = tree.knn(q, 7)
+        d = np.linalg.norm(pts - q[None], axis=1)
+        want = np.argsort(d)[:7]
+        assert [i for i, _ in got] == list(want)
+        np.testing.assert_allclose([dd for _, dd in got], d[want],
+                                   rtol=1e-10)
+
+    def test_kdtree_insert_path(self, rng):
+        pts = rng.normal(0, 1, (50, 3))
+        tree = KDTree(3)
+        for i, p in enumerate(pts):
+            tree.insert(p, i)
+        assert tree.size == 50
+        q = rng.normal(0, 1, 3)
+        idx, dist = tree.nn(q)
+        d = np.linalg.norm(pts - q[None], axis=1)
+        assert idx == int(np.argmin(d))
+
+    def test_vptree_knn_matches_bruteforce(self, rng):
+        pts = rng.normal(0, 1, (150, 8))
+        tree = VPTree(pts)
+        q = rng.normal(0, 1, 8)
+        got = [i for i, _ in tree.knn(q, 5)]
+        d = np.linalg.norm(pts - q[None], axis=1)
+        assert got == list(np.argsort(d)[:5])
+
+    def test_vptree_cosine(self, rng):
+        pts = rng.normal(0, 1, (100, 6))
+        tree = VPTree(pts, distance="cosine")
+        q = rng.normal(0, 1, 6)
+        got = [i for i, _ in tree.knn(q, 3)]
+        sims = (pts @ q) / (np.linalg.norm(pts, axis=1)
+                            * np.linalg.norm(q) + 1e-12)
+        assert got == list(np.argsort(1.0 - sims)[:3])
+
+    def test_quadtree_range_query(self, rng):
+        pts = rng.uniform(-1, 1, (300, 2))
+        tree = QuadTree(pts)
+        center, hw = (0.2, -0.1), (0.3, 0.25)
+        got = tree.query_range(center, hw)
+        want = [i for i, p in enumerate(pts)
+                if abs(p[0] - center[0]) <= hw[0]
+                and abs(p[1] - center[1]) <= hw[1]]
+        assert got == sorted(want)
+
+    def test_quadtree_rejects_non_2d(self, rng):
+        with pytest.raises(ValueError):
+            QuadTree(rng.normal(0, 1, (10, 3)))
+
+    def test_sptree_matches_exact_repulsion(self, rng):
+        """theta=0 must reproduce the exact O(n²) repulsive force."""
+        y = rng.normal(0, 1, (60, 2))
+        tree = SpTree(y)
+        neg_f = np.zeros_like(y)
+        sum_q = 0.0
+        for i in range(60):
+            sum_q += tree.compute_non_edge_forces(i, 0.0, neg_f[i])
+        # exact
+        diff = y[:, None, :] - y[None, :, :]
+        d2 = np.sum(diff * diff, axis=-1)
+        q = 1.0 / (1.0 + d2)
+        np.fill_diagonal(q, 0.0)
+        exact_sum_q = q.sum()
+        exact_neg = np.einsum("ij,ijk->ik", q * q, diff)
+        np.testing.assert_allclose(sum_q, exact_sum_q, rtol=1e-8)
+        np.testing.assert_allclose(neg_f, exact_neg, rtol=1e-8, atol=1e-10)
+
+    def test_sptree_theta_approximation_close(self, rng):
+        y = rng.normal(0, 1, (120, 2))
+        tree = SpTree(y)
+        approx = np.zeros_like(y)
+        for i in range(120):
+            tree.compute_non_edge_forces(i, 0.5, approx[i])
+        exact = np.zeros_like(y)
+        tree2 = SpTree(y)
+        for i in range(120):
+            tree2.compute_non_edge_forces(i, 0.0, exact[i])
+        err = np.linalg.norm(approx - exact) / np.linalg.norm(exact)
+        assert err < 0.1
+
+
+class TestTsne:
+    def test_exact_tsne_separates_blobs(self, rng):
+        pts, labels = three_blobs(rng, n_per=30)
+        ts = Tsne(perplexity=8, max_iter=250, seed=7)
+        y = ts.fit_transform(pts)
+        assert y.shape == (90, 2)
+        # centroid separation exceeds within-blob spread
+        cents = np.stack([y[labels == c].mean(0) for c in range(3)])
+        spread = max(np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                     for c in range(3))
+        min_sep = min(np.linalg.norm(cents[a] - cents[b])
+                      for a in range(3) for b in range(a + 1, 3))
+        assert min_sep > 2.0 * spread
+
+    def test_exact_tsne_kl_decreases(self, rng):
+        pts, _ = three_blobs(rng, n_per=20)
+        ts = Tsne(perplexity=8, max_iter=300, seed=3)
+        ts.fit_transform(pts)
+        assert ts.kl_history[-1] < ts.kl_history[0]
+
+    def test_barnes_hut_separates_blobs(self, rng):
+        pts, labels = three_blobs(rng, n_per=25)
+        y = BarnesHutTsne(perplexity=8, max_iter=150,
+                          seed=7).fit_transform(pts)
+        assert y.shape == (75, 2)
+        cents = np.stack([y[labels == c].mean(0) for c in range(3)])
+        spread = max(np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                     for c in range(3))
+        min_sep = min(np.linalg.norm(cents[a] - cents[b])
+                      for a in range(3) for b in range(a + 1, 3))
+        assert min_sep > 1.5 * spread
+
+
+class TestReviewRegressions:
+    """Fixes from code review: cosine VP-tree pruning, duplicate points in
+    SpTree, empty-tree errors, zero-iteration k-means."""
+
+    def test_vptree_cosine_many_seeds(self):
+        for seed in range(30):
+            r = np.random.default_rng(seed)
+            pts = r.normal(0, 1, (60, 4))
+            q = r.normal(0, 1, 4)
+            got = [i for i, _ in VPTree(pts, distance="cosine").knn(q, 5)]
+            sims = (pts @ q) / (np.linalg.norm(pts, axis=1)
+                                * np.linalg.norm(q) + 1e-12)
+            assert got == list(np.argsort(1.0 - sims)[:5]), f"seed {seed}"
+
+    def test_vptree_cosine_distance_values(self, rng):
+        pts = rng.normal(0, 1, (40, 3))
+        q = rng.normal(0, 1, 3)
+        got = VPTree(pts, distance="cosine").knn(q, 3)
+        for idx, d in got:
+            cos = np.dot(pts[idx], q) / (np.linalg.norm(pts[idx])
+                                         * np.linalg.norm(q))
+            np.testing.assert_allclose(d, 1.0 - cos, atol=1e-10)
+
+    def test_sptree_duplicate_points(self):
+        pts = np.array([[0.0, 0.0], [0.0, 0.0], [1.0, 1.0]])
+        tree = SpTree(pts)
+        assert tree.root.n_points == 3
+        neg = np.zeros(2)
+        # self-exclusion: point 0 must still see its duplicate (point 1)
+        sum_q = tree.compute_non_edge_forces(0, 0.0, neg)
+        # exact: q(0,1)=1/(1+0)=1, q(0,2)=1/(1+2)=1/3
+        np.testing.assert_allclose(sum_q, 1.0 + 1.0 / 3.0, rtol=1e-12)
+
+    def test_quadtree_duplicate_points_range_query(self):
+        pts = np.array([[0.5, 0.5], [0.5, 0.5], [-0.5, -0.5]])
+        tree = QuadTree(pts)
+        assert tree.query_range((0.5, 0.5), (0.01, 0.01)) == [0, 1]
+
+    def test_kdtree_empty_nn_raises(self):
+        with pytest.raises(ValueError):
+            KDTree(3).nn(np.zeros(3))
+
+    def test_kmeans_zero_iterations_rejected(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(3, max_iterations=0)

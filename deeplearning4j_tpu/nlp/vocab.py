@@ -140,6 +140,40 @@ class Huffman:
             vw.points = np.asarray(points, np.int32)
 
 
+def padded_paths(codes_list, points_list):
+    """Pad per-row Huffman paths into the [R, C] (points, codes, mask)
+    layout consumed by the jitted HS step (shared by word2vec and DeepWalk).
+
+    ``codes_list[i]``/``points_list[i]`` are the row's bit path and
+    inner-node indices (or None for an uncodable row).
+    """
+    rows = len(codes_list)
+    c = max((len(x) for x in codes_list if x is not None), default=0)
+    c = max(c, 1)
+    points = np.zeros((rows, c), np.int32)
+    codes = np.zeros((rows, c), np.float32)
+    mask = np.zeros((rows, c), np.float32)
+    for i, path in enumerate(codes_list):
+        if path is None:
+            continue
+        k = len(path)
+        points[i, :k] = points_list[i]
+        codes[i, :k] = path
+        mask[i, :k] = 1.0
+    return points, codes, mask
+
+
+def padded_huffman_paths(vocab: VocabCache):
+    """(points, codes, mask) for a Huffman-coded vocab, row = word index."""
+    n = vocab.num_words()
+    codes_list = [None] * n
+    points_list = [None] * n
+    for vw in vocab.vocab_words():
+        codes_list[vw.index] = vw.codes
+        points_list[vw.index] = vw.points
+    return padded_paths(codes_list, points_list)
+
+
 def unigram_table(vocab: VocabCache, table_size: int = 1_000_000,
                   power: float = 0.75) -> np.ndarray:
     """Negative-sampling unigram table (InMemoryLookupTable's ``table``):

@@ -33,6 +33,7 @@ from deeplearning4j_tpu.nlp.vocab import (
     Huffman,
     VocabCache,
     build_vocab,
+    padded_huffman_paths,
     unigram_table,
 )
 
@@ -331,16 +332,8 @@ class Word2Vec:
             self.reset_weights()
         sentences = self._corpus_indices()
         if self.hierarchic_softmax:
-            max_code = max((len(vw.codes) for vw in self.vocab.vocab_words()),
-                           default=1)
-            points_tbl = np.zeros((self.vocab.num_words(), max_code), np.int32)
-            codes_tbl = np.zeros((self.vocab.num_words(), max_code), np.float32)
-            mask_tbl = np.zeros((self.vocab.num_words(), max_code), np.float32)
-            for vw in self.vocab.vocab_words():
-                c = len(vw.codes)
-                points_tbl[vw.index, :c] = vw.points
-                codes_tbl[vw.index, :c] = vw.codes
-                mask_tbl[vw.index, :c] = 1.0
+            points_tbl, codes_tbl, mask_tbl = padded_huffman_paths(
+                self.vocab)
 
         total_steps = 0
         planned = max(1, self.epochs * self.iterations)

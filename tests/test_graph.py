@@ -1,0 +1,256 @@
+"""Graph embeddings tests: structure, loaders, walks, Huffman, DeepWalk.
+
+Models the reference's tests (GraphTestCase, RandomWalkIteratorTest,
+DeepWalkGradientCheck — SURVEY §4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphHuffman, GraphLoader, GraphVectorSerializer,
+    NoEdgeHandling, RandomWalkIterator, WeightedRandomWalkIterator)
+from deeplearning4j_tpu.nlp.word2vec import _hs_step
+
+
+def two_cliques(k=6):
+    """Two k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(k - 1, k)  # bridge
+    return g
+
+
+class TestGraph:
+    def test_undirected_edges(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, weight=2.5)
+        assert g.connected_vertices(1) == [0, 2]
+        assert g.connected_vertices(2) == [1]
+        assert g.edge_weight(2, 1) == 2.5
+        assert g.num_edges() == 2
+        assert g.degree(1) == 2
+
+    def test_directed_edges(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1)
+        assert g.connected_vertices(0) == [1]
+        assert g.connected_vertices(1) == []
+        assert g.num_edges() == 1
+
+    def test_edge_out_of_range(self):
+        g = Graph(2)
+        with pytest.raises(ValueError):
+            g.add_edge(0, 5)
+
+    def test_edge_list_loader(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0 1\n1 2 3.5\n\n2 3\n")
+        g = GraphLoader.load_edge_list(str(p), 4)
+        assert g.num_edges() == 3
+        assert g.edge_weight(1, 2) == 3.5
+
+    def test_adjacency_list_loader(self, tmp_path):
+        p = tmp_path / "adj.txt"
+        p.write_text("0 1 2\n1 2\n")
+        g = GraphLoader.load_adjacency_list(str(p), 3)
+        assert g.connected_vertices(0) == [1, 2]
+        assert g.connected_vertices(1) == [2]
+
+
+class TestWalks:
+    def test_walk_shape_and_validity(self):
+        g = two_cliques()
+        it = RandomWalkIterator(g, walk_length=10, seed=1)
+        walks = list(it)
+        assert len(walks) == g.num_vertices
+        for w in walks:
+            assert len(w) == 11
+            for a, b in zip(w[:-1], w[1:]):
+                assert b in g.connected_vertices(a) or a == b
+
+    def test_each_vertex_starts_one_walk(self):
+        g = two_cliques()
+        it = RandomWalkIterator(g, walk_length=3, seed=7)
+        starts = sorted(w[0] for w in it)
+        assert starts == list(range(g.num_vertices))
+
+    def test_disconnected_self_loop(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1)  # vertex 2 has no out-edges
+        it = RandomWalkIterator(g, walk_length=4, seed=3)
+        for w in it:
+            if w[0] == 2:
+                assert all(x == 2 for x in w)
+
+    def test_disconnected_exception(self):
+        g = Graph(2, directed=True)
+        g.add_edge(0, 1)
+        it = RandomWalkIterator(
+            g, walk_length=2, seed=3,
+            no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+        with pytest.raises(RuntimeError):
+            list(it)
+
+    def test_weighted_walk_respects_weights(self):
+        # vertex 0 connects to 1 (weight 100) and 2 (weight 0.01):
+        # nearly all first steps from 0 should go to 1
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1, 100.0)
+        g.add_edge(0, 2, 0.01)
+        g.add_edge(1, 0)
+        g.add_edge(2, 0)
+        hits = {1: 0, 2: 0}
+        for seed in range(50):
+            it = WeightedRandomWalkIterator(g, walk_length=1, seed=seed)
+            for w in it:
+                if w[0] == 0:
+                    hits[int(w[1])] += 1
+        assert hits[1] > 45
+
+    def test_reset_is_deterministic(self):
+        g = two_cliques()
+        it = RandomWalkIterator(g, walk_length=5, seed=9)
+        first = [w.copy() for w in it]
+        it.reset()
+        second = [w.copy() for w in it]
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestGraphHuffman:
+    def test_codes_prefix_free_and_points_in_range(self):
+        g = two_cliques()
+        h = GraphHuffman(g)
+        v = g.num_vertices
+        codes = ["".join(str(int(b)) for b in h.codes[i]) for i in range(v)]
+        assert len(set(codes)) == v
+        for a in codes:
+            for b in codes:
+                if a != b:
+                    assert not b.startswith(a)
+        for i in range(v):
+            assert np.all(h.points[i] >= 0)
+            assert np.all(h.points[i] < v - 1)
+
+    def test_padded_paths_mask(self):
+        g = two_cliques()
+        h = GraphHuffman(g)
+        points, codes, mask = h.padded_paths()
+        v = g.num_vertices
+        assert points.shape == codes.shape == mask.shape
+        assert points.shape[0] == v
+        for i in range(v):
+            assert int(mask[i].sum()) == len(h.codes[i])
+
+
+class TestHSGradient:
+    """DeepWalkGradientCheck analog: the hand-written _hs_step update must
+    match jax.grad of the explicit HS loss."""
+
+    def test_hs_step_matches_autodiff(self, rng):
+        v, d, c = 7, 5, 3
+        syn0 = rng.normal(0, 0.3, (v, d)).astype(np.float32)
+        syn1 = rng.normal(0, 0.3, (v - 1, d)).astype(np.float32)
+        # one pair per distinct center/target → row_scale is 1
+        centers = np.array([0, 1], np.int32)
+        points = np.array([[0, 1, 2], [3, 4, 0]], np.int32)
+        codes = np.array([[0, 1, 0], [1, 0, 0]], np.float32)
+        mask = np.array([[1, 1, 1], [1, 1, 0]], np.float32)
+        lr = 0.1
+
+        def explicit_loss(s0, s1):
+            h = s0[centers]
+            u = jnp.einsum("bd,bcd->bc", h, s1[points])
+            sign = 1.0 - 2.0 * codes
+            return -jnp.sum(mask * jax.nn.log_sigmoid(sign * u))
+
+        g0, g1 = jax.grad(explicit_loss, argnums=(0, 1))(
+            jnp.asarray(syn0), jnp.asarray(syn1))
+        new0, new1, _ = _hs_step(
+            jnp.asarray(syn0), jnp.asarray(syn1), jnp.asarray(centers),
+            jnp.asarray(points), jnp.asarray(codes), jnp.asarray(mask),
+            jnp.float32(lr))
+        np.testing.assert_allclose(np.asarray(new0), syn0 - lr * np.asarray(g0),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(new1), syn1 - lr * np.asarray(g1),
+                                   rtol=1e-4, atol=1e-6)
+
+
+class TestDeepWalk:
+    def test_learns_cluster_structure(self):
+        g = two_cliques(6)
+        dw = (DeepWalk.Builder().vector_size(16).window_size(3)
+              .learning_rate(0.2).batch_size(128).seed(42).build())
+        dw.initialize(g)
+        dw.fit(RandomWalkIterator(g, walk_length=8, seed=11), epochs=100)
+        within = np.mean([dw.similarity(a, b)
+                          for a in range(5) for b in range(a + 1, 5)])
+        across = np.mean([dw.similarity(a, b)
+                          for a in range(5) for b in range(7, 12)])
+        assert within > across + 0.2
+
+    def test_vertices_nearest_same_clique(self):
+        g = two_cliques(6)
+        dw = (DeepWalk.Builder().vector_size(16).window_size(3)
+              .learning_rate(0.2).batch_size(128).seed(42).build())
+        dw.initialize(g)
+        dw.fit(RandomWalkIterator(g, walk_length=8, seed=11), epochs=100)
+        near = dw.vertices_nearest(0, top_n=3)
+        assert all(n < 6 for n in near)
+
+    def test_loss_decreases(self):
+        g = two_cliques(5)
+        dw = (DeepWalk.Builder().vector_size(8).window_size(2)
+              .learning_rate(0.2).batch_size(128).seed(1).build())
+        dw.initialize(g)
+        dw.fit(RandomWalkIterator(g, walk_length=6, seed=2), epochs=60)
+        k = max(1, len(dw.loss_history) // 5)
+        assert (np.mean(dw.loss_history[-k:])
+                < np.mean(dw.loss_history[:k]))
+
+    def test_fit_before_initialize_raises(self):
+        dw = DeepWalk.Builder().build()
+        with pytest.raises(RuntimeError):
+            dw.fit(RandomWalkIterator(two_cliques(), 4))
+
+    def test_serializer_roundtrip(self, tmp_path):
+        g = two_cliques(4)
+        dw = DeepWalk.Builder().vector_size(8).build()
+        dw.initialize(g)
+        path = str(tmp_path / "vecs.txt")
+        GraphVectorSerializer.write_graph_vectors(dw, path)
+        back = GraphVectorSerializer.read_graph_vectors(path)
+        np.testing.assert_allclose(back, dw.syn0, rtol=1e-5, atol=1e-7)
+
+
+class TestReviewRegressions:
+    def test_self_loop_edge_count(self):
+        g = Graph(3)
+        g.add_edge(0, 0)
+        assert g.num_edges() == 1
+        g.add_edge(0, 1)
+        assert g.num_edges() == 2
+
+    def test_negative_vertex_query_raises(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        with pytest.raises(ValueError):
+            g.connected_vertices(-1)
+        with pytest.raises(ValueError):
+            g.degree(-1)
+
+    def test_weighted_walk_negative_weight_raises(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1, 3.0)
+        g.add_edge(0, 2, -1.0)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=0)
+        with pytest.raises(ValueError):
+            list(it)

@@ -11,6 +11,14 @@ re-enter a single jitted value-and-grad function without recompiling
 """
 
 from deeplearning4j_tpu.optimize.solver import Solver  # noqa: F401
+from deeplearning4j_tpu.optimize.function import (  # noqa: F401
+    BackTrackLineSearch,
+    EpsTermination,
+    Norm2Termination,
+    TerminationCondition,
+    ZeroDirection,
+    minimize,
+)
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     ComposableIterationListener,
     IterationListener,

@@ -12,7 +12,6 @@ heavy and O(params) cheap.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import numpy as np
@@ -20,32 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
-
-
-class BackTrackLineSearch:
-    """Armijo backtracking line search (BackTrackLineSearch.java)."""
-
-    def __init__(self, score_fn, max_iterations: int = 5, c1: float = 1e-4,
-                 shrink: float = 0.5, initial_step: float = 1.0):
-        self.score_fn = score_fn
-        self.max_iterations = max_iterations
-        self.c1 = c1
-        self.shrink = shrink
-        self.initial_step = initial_step
-
-    def optimize(self, params: np.ndarray, score0: float, grad: np.ndarray,
-                 direction: np.ndarray) -> float:
-        """Returns a step size along ``direction``."""
-        slope = float(np.dot(grad, direction))
-        if slope >= 0:  # not a descent direction — ZeroDirection guard
-            return 0.0
-        step = self.initial_step
-        for _ in range(self.max_iterations):
-            new_score = float(self.score_fn(params + step * direction))
-            if new_score <= score0 + self.c1 * step * slope:
-                return step
-            step *= self.shrink
-        return step
+from deeplearning4j_tpu.optimize.function import Norm2Termination, minimize
 
 
 class Solver:
@@ -108,83 +82,25 @@ class Solver:
         score_of = lambda flat: loss_fn(jnp.asarray(flat), x, y, fm, lm)
         params = np.asarray(net.get_flat_params(), np.float64)
 
-        line = BackTrackLineSearch(
-            score_of, max_iterations=self.conf.max_num_line_search_iterations)
-        lr = self.conf.learning_rate
+        def vg_flat(flat):
+            s, g = vg(jnp.asarray(flat), x, y, fm, lm)
+            return float(s), np.asarray(g, np.float64)
 
-        # CG / LBFGS memory
-        prev_grad = None
-        prev_params = None
-        direction = None
-        lbfgs_s, lbfgs_y = [], []
-        m = 10
-
-        score = None
-        for it in range(iterations):
-            score_j, grad_j = vg(jnp.asarray(params), x, y, fm, lm)
-            score = float(score_j)
-            grad = np.asarray(grad_j, np.float64)
-            gnorm = float(np.linalg.norm(grad))
-            if gnorm < 1e-10:  # Norm2Termination
-                break
-
-            if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
-                params = params - lr * grad
-            elif algo == OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
-                direction = -grad
-                step = line.optimize(params, score, grad, direction)
-                params = params + step * direction
-            elif algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
-                if prev_grad is None:
-                    direction = -grad
-                else:
-                    # Polak–Ribière with automatic restart
-                    beta = max(0.0, float(np.dot(grad, grad - prev_grad)
-                                          / (np.dot(prev_grad, prev_grad) + 1e-20)))
-                    direction = -grad + beta * direction
-                step = line.optimize(params, score, grad, direction)
-                params = params + step * direction
-                prev_grad = grad
-            elif algo == OptimizationAlgorithm.LBFGS:
-                # update memory with the (s, y) pair from the previous step
-                if prev_grad is not None and prev_params is not None:
-                    s_k = params - prev_params
-                    y_k = grad - prev_grad
-                    if np.dot(s_k, y_k) > 1e-10:  # curvature condition
-                        lbfgs_s.append(s_k)
-                        lbfgs_y.append(y_k)
-                        if len(lbfgs_s) > m:
-                            lbfgs_s.pop(0)
-                            lbfgs_y.pop(0)
-                # two-loop recursion
-                q = grad.copy()
-                alphas = []
-                for s_i, y_i in zip(reversed(lbfgs_s), reversed(lbfgs_y)):
-                    rho = 1.0 / (np.dot(y_i, s_i) + 1e-20)
-                    a = rho * np.dot(s_i, q)
-                    q -= a * y_i
-                    alphas.append((rho, a, s_i, y_i))
-                if lbfgs_y:
-                    gamma = (np.dot(lbfgs_s[-1], lbfgs_y[-1])
-                             / (np.dot(lbfgs_y[-1], lbfgs_y[-1]) + 1e-20))
-                    q *= gamma
-                for rho, a, s_i, y_i in reversed(alphas):
-                    b = rho * np.dot(y_i, q)
-                    q += (a - b) * s_i
-                direction = -q
-                step = line.optimize(params, score, grad, direction)
-                prev_params = params.copy()
-                prev_grad = grad
-                params = params + step * direction
-            else:
-                raise ValueError(f"unknown algorithm {algo}")
-
+        def on_iteration(cur_params, score, it):
             net.iteration_count += 1
             net.score_value = score
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration_count)
 
+        params, score, history = minimize(
+            vg_flat, params, algo=algo, iterations=iterations,
+            learning_rate=self.conf.learning_rate, score_fn=score_of,
+            max_line_search_iterations=(
+                self.conf.max_num_line_search_iterations),
+            terminations=(Norm2Termination(),),  # keep fixed-iteration
+            callback=on_iteration)               # semantics of fit()
+
         net.set_flat_params(params.astype(np.float32))
-        if score is not None:
+        if history:
             net.score_value = score
         return net.score_value

@@ -1,0 +1,210 @@
+"""CLI driver: subcommand dispatch + train/test/predict execution.
+
+Reference: ``cli/driver/CommandLineInterfaceDriver.java:60`` (main
+dispatches subcommands), ``cli/subcommands/Train.java:128`` (execute():
+load properties → build record reader → fromJson model conf → fit → save),
+``Test.java``, ``Predict.java``. The reference's properties-file keys
+(``input.format`` etc. at Train.java:68-75) are mirrored with the same
+flag-overrides-properties precedence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    """Java-style properties: key=value lines, '#'/'!' comments."""
+    props: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line[0] in "#!":
+                continue
+            if "=" in line:
+                k, _, v = line.partition("=")
+            elif ":" in line:
+                k, _, v = line.partition(":")
+            else:
+                continue
+            props[k.strip()] = v.strip()
+    return props
+
+
+def _build_reader(input_path: str, input_format: str, zero_based: bool,
+                  num_features: Optional[int]):
+    from deeplearning4j_tpu.datasets.records import (
+        CSVRecordReader, SVMLightRecordReader)
+
+    if input_format == "csv":
+        return CSVRecordReader(input_path)
+    if input_format == "svmlight":
+        if num_features is None:
+            # infer from the file's max index; pass --num-features /
+            # input.num.features to pin the width across train and test
+            # files with different trailing sparsity
+            max_idx = 0
+            with open(input_path) as f:
+                for line in f:
+                    for tok in line.split()[1:]:
+                        if ":" in tok:
+                            max_idx = max(max_idx, int(tok.split(":")[0]))
+            num_features = max_idx + 1 if zero_based else max_idx
+        return SVMLightRecordReader(input_path, num_features=num_features,
+                                    zero_based=zero_based)
+    raise ValueError(f"unknown input format: {input_format}")
+
+
+def _build_iterator(args, props: Dict[str, str]):
+    from deeplearning4j_tpu.datasets.records import (
+        RecordReaderDataSetIterator)
+
+    input_format = args.input_format or props.get("input.format", "csv")
+    batch_size = (args.batch_size if args.batch_size is not None
+                  else int(props.get("batch.size", "32")))
+    label_index = (args.label_index if args.label_index is not None
+                   else int(props.get("input.label.index", "-1")))
+    num_classes = (args.num_classes if args.num_classes is not None
+                   else (int(props["input.num.classes"])
+                         if "input.num.classes" in props else None))
+    num_features = (args.num_features if args.num_features is not None
+                    else (int(props["input.num.features"])
+                          if "input.num.features" in props else None))
+    zero_based = args.zero_based or (
+        props.get("input.zero.based", "false").lower() == "true")
+    regression = args.regression or (
+        props.get("input.regression", "false").lower() == "true")
+    reader = _build_reader(args.input, input_format, zero_based,
+                           num_features)
+    return RecordReaderDataSetIterator(
+        reader, batch_size, label_index=label_index,
+        num_classes=num_classes, regression=regression)
+
+
+def _full_dataset(it, input_path: str):
+    """Drain an iterator into one DataSet (for eval/predict)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    batches = []
+    it.reset()
+    while it.has_next():
+        batches.append(it.next())
+    if not batches:
+        raise SystemExit(f"no records in input file: {input_path}")
+    return DataSet.merge(batches)
+
+
+def cmd_train(args) -> int:
+    from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+    props = load_properties(args.conf) if args.conf else {}
+    with open(args.model) as f:
+        conf = MultiLayerConfiguration.from_json(f.read())
+    net = MultiLayerNetwork(conf).init()
+    it = _build_iterator(args, props)
+    epochs = (args.epochs if args.epochs is not None
+              else int(props.get("epochs", "1")))
+    for _ in range(epochs):
+        it.reset()
+        net.fit(it)
+    ModelSerializer.write_model(net, args.output)
+    print(f"model trained ({epochs} epoch(s)) and saved to {args.output}")
+    return 0
+
+
+def cmd_test(args) -> int:
+    from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+    props = load_properties(args.conf) if args.conf else {}
+    net = ModelSerializer.restore(args.model)
+    it = _build_iterator(args, props)
+    ds = _full_dataset(it, args.input)
+    ev = net.evaluate(ds)
+    print(ev.stats())
+    return 0
+
+
+def cmd_predict(args) -> int:
+    from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+    props = load_properties(args.conf) if args.conf else {}
+    net = ModelSerializer.restore(args.model)
+    it = _build_iterator(args, props)
+    ds = _full_dataset(it, args.input)
+    out = np.asarray(net.output(ds.features))
+    lines: List[str] = []
+    if args.probabilities:
+        for row in out:
+            lines.append(" ".join(f"{p:.6g}" for p in row))
+    else:
+        for row in out:
+            lines.append(str(int(np.argmax(row))))
+    text = "\n".join(lines) + "\n"
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"wrote {len(lines)} predictions to {args.output}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def _add_data_flags(p: argparse.ArgumentParser):
+    p.add_argument("-input", "--input", required=True,
+                   help="input data file")
+    p.add_argument("-conf", "--conf", default=None,
+                   help="java-style properties file")
+    p.add_argument("--input-format", choices=["csv", "svmlight"],
+                   default=None, help="overrides input.format property")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--label-index", type=int, default=None)
+    p.add_argument("--num-classes", type=int, default=None)
+    p.add_argument("--num-features", type=int, default=None,
+                   help="svmlight feature width (else inferred from file)")
+    p.add_argument("--zero-based", action="store_true",
+                   help="svmlight indices start at 0")
+    p.add_argument("--regression", action="store_true")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu",
+        description="train / test / predict on the TPU-native framework")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_train = sub.add_parser("train", help="fit a model from a JSON conf")
+    _add_data_flags(p_train)
+    p_train.add_argument("-model", "--model", required=True,
+                         help="model configuration JSON file")
+    p_train.add_argument("-output", "--output", required=True,
+                         help="path for the saved model zip")
+    p_train.add_argument("--epochs", type=int, default=None)
+    p_train.set_defaults(fn=cmd_train)
+
+    p_test = sub.add_parser("test", help="evaluate a saved model")
+    _add_data_flags(p_test)
+    p_test.add_argument("-model", "--model", required=True,
+                        help="saved model zip")
+    p_test.set_defaults(fn=cmd_test)
+
+    p_pred = sub.add_parser("predict", help="predict with a saved model")
+    _add_data_flags(p_pred)
+    p_pred.add_argument("-model", "--model", required=True,
+                        help="saved model zip")
+    p_pred.add_argument("-output", "--output", default=None,
+                        help="output file (stdout if omitted)")
+    p_pred.add_argument("--probabilities", action="store_true",
+                        help="emit class probabilities, not argmax labels")
+    p_pred.set_defaults(fn=cmd_predict)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

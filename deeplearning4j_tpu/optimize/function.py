@@ -97,12 +97,18 @@ def minimize(value_and_grad: Callable, params0: np.ndarray,
              lbfgs_memory: int = 10,
              terminations: Optional[Sequence[TerminationCondition]] = None,
              callback: Optional[Callable[[np.ndarray, float, int], None]]
-             = None) -> Tuple[np.ndarray, float, List[float]]:
+             = None,
+             rescore_final: bool = True
+             ) -> Tuple[np.ndarray, float, List[float]]:
     """Minimize a scalar function of a flat vector.
 
     ``value_and_grad(params) -> (score, grad)``; ``score_fn(params) ->
     score`` (defaults to value_and_grad's score; used by the line search).
     Returns (params, final_score, score_history).
+
+    ``rescore_final=False`` skips the extra evaluation that makes the
+    returned score exact for the returned params — per-minibatch callers
+    (the network Solver) don't want a second forward pass per batch.
     """
     params = np.asarray(params0, np.float64).copy()
     if score_fn is None:
@@ -190,7 +196,7 @@ def minimize(value_and_grad: Callable, params0: np.ndarray,
         if callback is not None:
             callback(params, score, it)
 
-    if stepped:
+    if stepped and rescore_final:
         # loop exhausted right after an update: score the final iterate so
         # the returned score matches the returned params
         score = float(score_fn(params))

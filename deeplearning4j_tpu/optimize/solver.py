@@ -98,7 +98,8 @@ class Solver:
             max_line_search_iterations=(
                 self.conf.max_num_line_search_iterations),
             terminations=(Norm2Termination(),),  # keep fixed-iteration
-            callback=on_iteration)               # semantics of fit()
+            callback=on_iteration,               # semantics of fit()
+            rescore_final=False)  # no extra fwd pass per minibatch
 
         net.set_flat_params(params.astype(np.float32))
         if history:

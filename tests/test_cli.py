@@ -1,0 +1,192 @@
+"""CLI tests: train/test/predict subcommands run in-process on toy data.
+
+Models the reference's CLI tests (TrainTest.java etc. run Train.execute()
+on SVMLight/properties fixtures).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli import main
+from deeplearning4j_tpu.cli.driver import load_properties
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+
+
+@pytest.fixture
+def toy_csv(tmp_path, rng):
+    """Separable 2-class CSV: 4 features + label column (last)."""
+    x = np.concatenate([rng.normal(-2, 0.5, (40, 4)),
+                        rng.normal(2, 0.5, (40, 4))])
+    y = np.repeat([0, 1], 40)
+    order = rng.permutation(80)
+    p = tmp_path / "data.csv"
+    with open(p, "w") as f:
+        for i in order:
+            f.write(",".join(f"{v:.5f}" for v in x[i]) + f",{y[i]}\n")
+    return str(p)
+
+
+@pytest.fixture
+def toy_svmlight(tmp_path, rng):
+    x = np.concatenate([rng.normal(-2, 0.5, (30, 3)),
+                        rng.normal(2, 0.5, (30, 3))])
+    y = np.repeat([0, 1], 30)
+    p = tmp_path / "data.svm"
+    with open(p, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j + 1}:{v:.5f}" for j, v in enumerate(xi))
+            f.write(f"{yi} {feats}\n")
+    return str(p)
+
+
+@pytest.fixture
+def conf_json(tmp_path):
+    conf = (NeuralNetConfiguration.Builder().seed(7).iterations(8)
+            .learning_rate(0.5).list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=2, activation="softmax"))
+            .build())
+    p = tmp_path / "conf.json"
+    p.write_text(conf.to_json())
+    return str(p)
+
+
+class TestTrainTestPredict:
+    def test_full_cycle_csv(self, tmp_path, toy_csv, conf_json, capsys):
+        model_out = str(tmp_path / "model.zip")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-output", model_out, "--num-classes", "2",
+                   "--epochs", "3", "--batch-size", "16"])
+        assert rc == 0
+        assert os.path.exists(model_out)
+
+        rc = main(["test", "-input", toy_csv, "-model", model_out,
+                   "--num-classes", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Accuracy" in out
+
+        pred_out = str(tmp_path / "preds.txt")
+        rc = main(["predict", "-input", toy_csv, "-model", model_out,
+                   "--num-classes", "2", "-output", pred_out])
+        assert rc == 0
+        preds = [int(l) for l in open(pred_out).read().split()]
+        assert len(preds) == 80
+        assert set(preds) <= {0, 1}
+
+    def test_predict_probabilities_stdout(self, tmp_path, toy_csv,
+                                          conf_json, capsys):
+        model_out = str(tmp_path / "model.zip")
+        main(["train", "-input", toy_csv, "-model", conf_json,
+              "-output", model_out, "--num-classes", "2"])
+        capsys.readouterr()
+        rc = main(["predict", "-input", toy_csv, "-model", model_out,
+                   "--num-classes", "2", "--probabilities"])
+        assert rc == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 80
+        row = [float(v) for v in lines[0].split()]
+        assert len(row) == 2
+        np.testing.assert_allclose(sum(row), 1.0, atol=1e-3)
+
+    def test_svmlight_with_properties(self, tmp_path, toy_svmlight, capsys):
+        conf = (NeuralNetConfiguration.Builder().seed(3).iterations(8)
+                .learning_rate(0.5).list()
+                .layer(0, L.DenseLayer(n_in=3, n_out=8, activation="tanh"))
+                .layer(1, L.OutputLayer(n_in=8, n_out=2,
+                                        activation="softmax"))
+                .build())
+        conf_p = tmp_path / "conf.json"
+        conf_p.write_text(conf.to_json())
+        props = tmp_path / "run.properties"
+        props.write_text(
+            "# run config\ninput.format=svmlight\nbatch.size=20\n"
+            "input.num.classes=2\nepochs=3\n")
+        model_out = str(tmp_path / "model.zip")
+        rc = main(["train", "-input", toy_svmlight, "-model", str(conf_p),
+                   "-conf", str(props), "-output", model_out])
+        assert rc == 0
+        rc = main(["test", "-input", toy_svmlight, "-model", model_out,
+                   "-conf", str(props)])
+        assert rc == 0
+        assert "Accuracy" in capsys.readouterr().out
+
+    def test_trained_model_accuracy(self, tmp_path, toy_csv, conf_json):
+        """End-to-end: the CLI-trained model must actually learn."""
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        from deeplearning4j_tpu.datasets.records import (
+            CSVRecordReader, RecordReaderDataSetIterator)
+
+        model_out = str(tmp_path / "model.zip")
+        main(["train", "-input", toy_csv, "-model", conf_json,
+              "-output", model_out, "--num-classes", "2", "--epochs", "5"])
+        net = ModelSerializer.restore(model_out)
+        it = RecordReaderDataSetIterator(CSVRecordReader(toy_csv), 80,
+                                         num_classes=2)
+        ds = it.next()
+        ev = net.evaluate(ds)
+        assert ev.accuracy() > 0.9
+
+
+class TestProperties:
+    def test_load_properties(self, tmp_path):
+        p = tmp_path / "x.properties"
+        p.write_text("# comment\n! also comment\na=1\nb: two\n\nmalformed\n"
+                     "spaced = v \n")
+        props = load_properties(str(p))
+        assert props == {"a": "1", "b": "two", "spaced": "v"}
+
+    def test_flag_overrides_property(self, tmp_path, toy_csv, conf_json,
+                                     capsys):
+        """--batch-size flag wins over batch.size property."""
+        props = tmp_path / "p.properties"
+        props.write_text("batch.size=7\ninput.num.classes=2\n")
+        model_out = str(tmp_path / "m.zip")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-conf", str(props), "-output", model_out,
+                   "--batch-size", "40"])
+        assert rc == 0
+
+
+class TestReviewRegressions:
+    def test_empty_input_clean_error(self, tmp_path, toy_csv, conf_json):
+        model_out = str(tmp_path / "m.zip")
+        main(["train", "-input", toy_csv, "-model", conf_json,
+              "-output", model_out, "--num-classes", "2"])
+        empty = tmp_path / "empty.csv"
+        empty.write_text("")
+        with pytest.raises(SystemExit, match="no records"):
+            main(["test", "-input", str(empty), "-model", model_out,
+                  "--num-classes", "2"])
+
+    def test_epochs_zero_respected(self, tmp_path, toy_csv, conf_json,
+                                   capsys):
+        model_out = str(tmp_path / "m.zip")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-output", model_out, "--num-classes", "2",
+                   "--epochs", "0"])
+        assert rc == 0
+        assert "0 epoch(s)" in capsys.readouterr().out
+
+    def test_zero_based_svmlight(self, tmp_path, rng):
+        # 0-based indices: feature 0 must land in column 0
+        p = tmp_path / "zb.svm"
+        p.write_text("1 0:5.0 2:7.0\n0 1:3.0\n")
+        from deeplearning4j_tpu.cli.driver import _build_reader
+        reader = _build_reader(str(p), "svmlight", zero_based=True,
+                               num_features=None)
+        label, x = reader.next()
+        assert label == 1.0
+        np.testing.assert_allclose(x, [5.0, 0.0, 7.0])
+
+    def test_num_features_pins_width(self, tmp_path):
+        p = tmp_path / "narrow.svm"
+        p.write_text("0 1:1.0\n")  # max index 1, but model wants 3
+        from deeplearning4j_tpu.cli.driver import _build_reader
+        reader = _build_reader(str(p), "svmlight", zero_based=False,
+                               num_features=3)
+        _, x = reader.next()
+        assert x.shape == (3,)

@@ -19,6 +19,10 @@ Modes:
   PartitionSpecs over a ``model`` axis.
 - ``ring_attention`` (ring_attention.py) — context parallelism over a
   ``sequence`` axis via shard_map + ppermute.
+- ``spmd_pipeline`` (pipeline_parallel.py) — GPipe microbatch pipelining over
+  a ``pipe`` axis via shard_map + ppermute.
+- ``moe_ffn`` (expert_parallel.py) — GShard-style mixture-of-experts with
+  expert-axis sharding; dispatch/combine all-to-alls derived by GSPMD.
 """
 
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
@@ -29,4 +33,17 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
 from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
     ParallelWrapper,
     ParameterAveragingTrainer,
+)
+from deeplearning4j_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    pipeline_train_step,
+    spmd_pipeline,
+    split_microbatches,
+    stack_stage_params,
+    shard_stage_params,
+)
+from deeplearning4j_tpu.parallel.expert_parallel import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    shard_moe_params,
 )

@@ -1,0 +1,149 @@
+"""Expert parallelism: mixture-of-experts FFN sharded over an ``expert`` axis.
+
+Greenfield relative to the reference (SURVEY §2.5: "NOT present in the
+reference: ... expert parallelism"). GShard-style dense dispatch: tokens are
+routed to experts with top-k gating under a capacity limit, dispatched with
+one einsum into an [E, C, d] expert-major buffer, processed by per-expert
+FFNs, and combined back. The expert dimension carries a sharding constraint
+over the ``expert`` mesh axis, so GSPMD partitions the per-expert FFNs
+across devices and inserts the all-to-alls at the dispatch/combine einsums —
+the collectives are compiler-derived from shardings, not hand-written.
+
+Load balancing follows the Switch/GShard auxiliary loss
+(E · Σ_e fraction_tokens(e) · mean_gate_prob(e)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import EXPERT_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+
+    def __post_init__(self):
+        if self.top_k > self.n_experts:
+            raise ValueError(
+                f"top_k={self.top_k} > n_experts={self.n_experts}: a token "
+                "would be dispatched to the same expert twice")
+
+
+def init_moe_params(cfg: MoEConfig, key) -> Dict[str, jnp.ndarray]:
+    """Router + stacked per-expert FFN weights ([E, ...] leading axis)."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    s_in = 1.0 / math.sqrt(cfg.d_model)
+    s_ff = 1.0 / math.sqrt(cfg.d_ff)
+    return {
+        "Wg": jax.random.normal(kg, (cfg.d_model, cfg.n_experts)) * s_in,
+        "W1": jax.random.normal(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff)) * s_in,
+        "b1": jnp.zeros((cfg.n_experts, cfg.d_ff)),
+        "W2": jax.random.normal(k2, (cfg.n_experts, cfg.d_ff, cfg.d_model)) * s_ff,
+        "b2": jnp.zeros((cfg.n_experts, cfg.d_model)),
+    }
+
+
+def shard_moe_params(params, mesh: Mesh, axis_name: str = EXPERT_AXIS):
+    """Shard the stacked expert weights over the expert axis; router is
+    replicated (every device routes its own tokens)."""
+    def put(name, leaf):
+        if name == "Wg" or axis_name not in mesh.shape:
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return {k: put(k, v) for k, v in params.items()}
+
+
+def expert_capacity(n_tokens: int, cfg: MoEConfig) -> int:
+    cap = int(math.ceil(cfg.capacity_factor * cfg.top_k * n_tokens
+                        / cfg.n_experts))
+    return max(cap, 1)
+
+
+def _top_k_dispatch(gates: jnp.ndarray, capacity: int, top_k: int):
+    """Build dispatch/combine tensors from gate probabilities.
+
+    gates: [T, E] softmax router outputs. Returns
+    (dispatch [T, E, C] bool-ish, combine [T, E, C] weights, aux_loss).
+    """
+    n_tokens, n_experts = gates.shape
+    dispatch = jnp.zeros((n_tokens, n_experts, capacity), gates.dtype)
+    combine = jnp.zeros((n_tokens, n_experts, capacity), gates.dtype)
+    # Position counters per expert accumulate across the k routing rounds so
+    # a token's 2nd-choice slot never collides with 1st-choice traffic.
+    fill = jnp.zeros((n_experts,), jnp.int32)
+    remaining = gates
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)                   # [T]
+        onehot = jax.nn.one_hot(choice, n_experts, dtype=gates.dtype)
+        # position of each token within its chosen expert's buffer
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot)     # [T, E]
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1).astype(jnp.int32)
+        pos = pos + jnp.take(fill, choice)                        # [T]
+        keep = pos < capacity
+        gate_val = jnp.sum(gates * onehot, axis=-1) * keep        # [T]
+        pos_c = jnp.clip(pos, 0, capacity - 1)
+        posh = jax.nn.one_hot(pos_c, capacity, dtype=gates.dtype)  # [T, C]
+        contrib = (onehot * keep[:, None])[:, :, None] * posh[:, None, :]
+        dispatch = dispatch + contrib
+        combine = combine + gate_val[:, None, None] * contrib
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    # Switch-style load-balance loss on 1st-choice assignment fractions.
+    first = jax.nn.one_hot(jnp.argmax(gates, -1), n_experts, dtype=gates.dtype)
+    frac_tokens = jnp.mean(first, axis=0)
+    mean_prob = jnp.mean(gates, axis=0)
+    aux = n_experts * jnp.sum(frac_tokens * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    mesh: Optional[Mesh] = None,
+    *,
+    axis_name: str = EXPERT_AXIS,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE feed-forward. x: [..., d_model] → (y [..., d_model], aux_loss).
+
+    With ``mesh`` given, the [E, C, d] expert-major intermediates carry
+    shardings on the expert axis — under jit over that mesh, GSPMD turns the
+    dispatch/combine einsums into all-to-alls over ICI.
+    """
+    lead = x.shape[:-1]
+    xt = x.reshape((-1, cfg.d_model))
+    n_tokens = xt.shape[0]
+    cap = expert_capacity(n_tokens, cfg)
+
+    gates = jax.nn.softmax(xt @ params["Wg"], axis=-1)            # [T, E]
+    dispatch, combine, aux = _top_k_dispatch(gates, cap, cfg.top_k)
+
+    exp_in = jnp.einsum("td,tec->ecd", xt, dispatch)              # [E, C, d]
+    if mesh is not None and axis_name in mesh.shape:
+        exp_in = lax.with_sharding_constraint(
+            exp_in, NamedSharding(mesh, P(axis_name, None, None)))
+    h = jax.nn.relu(
+        jnp.einsum("ecd,edf->ecf", exp_in, params["W1"])
+        + params["b1"][:, None, :])
+    exp_out = (jnp.einsum("ecf,efd->ecd", h, params["W2"])
+               + params["b2"][:, None, :])
+    if mesh is not None and axis_name in mesh.shape:
+        exp_out = lax.with_sharding_constraint(
+            exp_out, NamedSharding(mesh, P(axis_name, None, None)))
+    y = jnp.einsum("ecd,tec->td", exp_out, combine)               # [T, d]
+    return y.reshape(lead + (cfg.d_model,)), cfg.aux_loss_weight * aux

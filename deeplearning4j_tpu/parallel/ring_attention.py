@@ -61,6 +61,12 @@ def ring_attention(
     """
     d = q.shape[-1]
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
+    if axis_name not in mesh.shape:
+        # size-1 sequence axis is dropped from the mesh: no ring, plain
+        # blockwise attention on the single device
+        pv, m, l = _block_attn(q, k, v, 0, 0, causal=causal, scale=scale_val)
+        denom = jnp.maximum(jnp.swapaxes(l, 1, 2)[..., None], 1e-30)
+        return (pv.astype(jnp.float32) / denom).astype(q.dtype)
     n_ring = mesh.shape[axis_name]
     t_local = q.shape[1] // n_ring
 

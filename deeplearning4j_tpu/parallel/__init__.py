@@ -47,3 +47,15 @@ from deeplearning4j_tpu.parallel.expert_parallel import (  # noqa: F401
     moe_ffn,
     shard_moe_params,
 )
+from deeplearning4j_tpu.parallel.statetracker import (  # noqa: F401
+    FileStateTracker,
+    InMemoryStateTracker,
+    Job,
+    StateTracker,
+)
+from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
+    ClusterConfig,
+    FaultTolerantTrainer,
+    HeartbeatMonitor,
+    initialize_distributed,
+)

@@ -60,12 +60,11 @@ def init_moe_params(cfg: MoEConfig, key) -> Dict[str, jnp.ndarray]:
 def shard_moe_params(params, mesh: Mesh, axis_name: str = EXPERT_AXIS):
     """Shard the stacked expert weights over the expert axis; router is
     replicated (every device routes its own tokens)."""
-    def put(name, leaf):
-        if name == "Wg" or axis_name not in mesh.shape:
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
-    return {k: put(k, v) for k, v in params.items()}
+    from deeplearning4j_tpu.parallel.mesh import shard_leading_axis
+    out = shard_leading_axis(
+        {k: v for k, v in params.items() if k != "Wg"}, mesh, axis_name)
+    out["Wg"] = jax.device_put(params["Wg"], NamedSharding(mesh, P()))
+    return out
 
 
 def expert_capacity(n_tokens: int, cfg: MoEConfig) -> int:

@@ -94,3 +94,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard axis 0 (batch) over 'data'; replicate the rest."""
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_leading_axis(tree, mesh: Mesh, axis_name: str):
+    """device_put every leaf with its leading dim sharded over ``axis_name``
+    (replicated everywhere else). When the axis was dropped from the mesh
+    (size 1), leaves are fully replicated."""
+    def put(leaf):
+        if axis_name not in mesh.shape:
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree.map(put, tree)

@@ -42,10 +42,8 @@ def stack_stage_params(per_stage_params) -> Any:
 
 def shard_stage_params(stacked, mesh: Mesh, axis_name: str = PIPE_AXIS):
     """Place stacked stage params so each device holds only its stage."""
-    def put(leaf):
-        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
-    return jax.tree.map(put, stacked)
+    from deeplearning4j_tpu.parallel.mesh import shard_leading_axis
+    return shard_leading_axis(stacked, mesh, axis_name)
 
 
 def spmd_pipeline(

@@ -1,0 +1,366 @@
+"""Cluster state tracking: jobs, worker updates, heartbeats.
+
+TPU-native re-design of the reference's StateTracker SPI
+(deeplearning4j-scaleout-api/.../statetracker/StateTracker.java) and its
+Hazelcast implementation (BaseHazelCastStateTracker.java, 972 LoC of
+distributed maps for jobs/updates/heartbeats). On TPU pods the data plane is
+XLA collectives over ICI, so the tracker's job shrinks to the *control*
+plane: work assignment, liveness, and replicated metadata. Two backends:
+
+- ``InMemoryStateTracker`` — thread-safe in-process maps (the embedded-
+  Hazelcast role; used by single-host tests the way the reference uses
+  ``BaseTestDistributed``).
+- ``FileStateTracker`` — a directory on a shared filesystem (GCS fuse / NFS
+  on TPU VMs) with atomic rename writes; processes on different hosts
+  coordinate through it without any extra service (the client-Hazelcast /
+  ZooKeeper role, SURVEY §2.5 "ZooKeeper config registry").
+
+Job lifecycle mirrors the reference (pending → claimed → done, with requeue
+on failure — JobFailed/ClearWorker protocol, actor/core/protocol/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Job:
+    """A unit of work (the reference's job/Job.java: work + worker id)."""
+
+    job_id: str
+    payload: Any = None
+    worker_id: Optional[str] = None
+    status: str = "pending"  # pending | claimed | done | failed
+    attempts: int = 0
+    result: Any = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"job_id": self.job_id, "payload": self.payload,
+                "worker_id": self.worker_id, "status": self.status,
+                "attempts": self.attempts, "result": self.result}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Job":
+        return Job(**d)
+
+
+class StateTracker:
+    """SPI: what every backend provides (StateTracker.java contract —
+    jobs, workerUpdates, heartbeats, replication)."""
+
+    # --- jobs ---
+    def add_job(self, payload: Any, job_id: Optional[str] = None) -> str:
+        raise NotImplementedError
+
+    def claim_job(self, worker_id: str) -> Optional[Job]:
+        raise NotImplementedError
+
+    def complete_job(self, job_id: str, result: Any = None) -> None:
+        raise NotImplementedError
+
+    def fail_job(self, job_id: str, requeue: bool = True) -> None:
+        raise NotImplementedError
+
+    def jobs(self, status: Optional[str] = None) -> List[Job]:
+        raise NotImplementedError
+
+    # --- heartbeats / liveness ---
+    def heartbeat(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        raise NotImplementedError
+
+    def workers(self) -> List[str]:
+        raise NotImplementedError
+
+    def evict_stale(self, timeout_s: float = 120.0) -> List[str]:
+        """Remove workers silent for >= timeout_s and requeue their claimed
+        jobs (MasterActor.java:141-171: 120 s stale-worker eviction)."""
+        raise NotImplementedError
+
+    # --- replicated key/value metadata (config registry role) ---
+    def put_meta(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def get_meta(self, key: str, default: Any = None) -> Any:
+        raise NotImplementedError
+
+
+class InMemoryStateTracker(StateTracker):
+    """Thread-safe in-process tracker (embedded-Hazelcast role)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, Job] = {}
+        self._order: List[str] = []
+        self._beats: Dict[str, float] = {}
+        self._meta: Dict[str, Any] = {}
+
+    def add_job(self, payload: Any, job_id: Optional[str] = None) -> str:
+        with self._lock:
+            jid = job_id or uuid.uuid4().hex
+            self._jobs[jid] = Job(jid, payload)
+            self._order.append(jid)
+            return jid
+
+    def claim_job(self, worker_id: str) -> Optional[Job]:
+        with self._lock:
+            for jid in self._order:
+                j = self._jobs[jid]
+                if j.status == "pending":
+                    j.status = "claimed"
+                    j.worker_id = worker_id
+                    j.attempts += 1
+                    return Job(**j.to_json())
+            return None
+
+    def complete_job(self, job_id: str, result: Any = None) -> None:
+        with self._lock:
+            j = self._jobs[job_id]
+            j.status = "done"
+            j.result = result
+
+    def fail_job(self, job_id: str, requeue: bool = True) -> None:
+        with self._lock:
+            j = self._jobs[job_id]
+            j.status = "pending" if requeue else "failed"
+            j.worker_id = None
+
+    def jobs(self, status: Optional[str] = None) -> List[Job]:
+        with self._lock:
+            out = [self._jobs[j] for j in self._order]
+            if status is not None:
+                out = [j for j in out if j.status == status]
+            return [Job(**j.to_json()) for j in out]
+
+    def heartbeat(self, worker_id: str) -> None:
+        with self._lock:
+            self._beats[worker_id] = time.time()
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        with self._lock:
+            return self._beats.get(worker_id)
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._beats)
+
+    def evict_stale(self, timeout_s: float = 120.0) -> List[str]:
+        with self._lock:
+            now = time.time()
+            stale = [w for w, t in self._beats.items()
+                     if now - t >= timeout_s]
+            for w in stale:
+                del self._beats[w]
+                for j in self._jobs.values():
+                    if j.worker_id == w and j.status == "claimed":
+                        j.status = "pending"
+                        j.worker_id = None
+            return stale
+
+    def put_meta(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._meta[key] = value
+
+    def get_meta(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._meta.get(key, default)
+
+
+class FileStateTracker(StateTracker):
+    """Directory-backed tracker for multi-process/multi-host coordination.
+
+    Layout: ``<root>/jobs/<id>.json``, ``<root>/beats/<worker>``,
+    ``<root>/meta/<key>.json``. All writes are atomic (tempfile + rename on
+    the same filesystem), so concurrent readers never see partial JSON.
+    Claims use exclusive-create lock files (``O_EXCL``) — the same
+    first-writer-wins discipline the reference gets from Hazelcast
+    distributed locks.
+    """
+
+    #: claim locks are held only for the claim/requeue transaction; any lock
+    #: older than this belongs to a crashed process and may be broken
+    LOCK_STALE_S = 60.0
+
+    def __init__(self, root: str):
+        self.root = root
+        for sub in ("jobs", "beats", "meta", "locks", "tmp"):
+            os.makedirs(os.path.join(root, sub), exist_ok=True)
+
+    # -- helpers --
+    def _atomic_write(self, path: str, data: str) -> None:
+        # staged in a separate tmp/ dir so directory listings of jobs/ and
+        # beats/ never see half-written entries
+        fd, tmp = tempfile.mkstemp(dir=os.path.join(self.root, "tmp"))
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _job_path(self, jid: str) -> str:
+        return os.path.join(self.root, "jobs", jid + ".json")
+
+    def _read_job(self, jid: str) -> Optional[Job]:
+        try:
+            with open(self._job_path(jid)) as f:
+                return Job.from_json(json.load(f))
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write_job(self, job: Job) -> None:
+        self._atomic_write(self._job_path(job.job_id),
+                           json.dumps(job.to_json()))
+
+    def _try_lock(self, name: str) -> bool:
+        path = os.path.join(self.root, "locks", name)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except FileExistsError:
+            # break locks abandoned by crashed processes (mtime-based; the
+            # unlink races benignly — O_EXCL arbitrates the re-create)
+            try:
+                if time.time() - os.path.getmtime(path) >= self.LOCK_STALE_S:
+                    os.unlink(path)
+                    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                    return True
+            except (FileNotFoundError, FileExistsError):
+                pass
+            return False
+
+    def _unlock(self, name: str) -> None:
+        try:
+            os.unlink(os.path.join(self.root, "locks", name))
+        except FileNotFoundError:
+            pass
+
+    # -- jobs --
+    def add_job(self, payload: Any, job_id: Optional[str] = None) -> str:
+        # time-prefixed ids preserve FIFO claim order via sorted listing
+        jid = job_id or f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+        self._write_job(Job(jid, payload))
+        return jid
+
+    def _job_ids(self) -> List[str]:
+        return sorted(p[:-5] for p in os.listdir(os.path.join(self.root, "jobs"))
+                      if p.endswith(".json"))
+
+    def claim_job(self, worker_id: str) -> Optional[Job]:
+        for jid in self._job_ids():
+            j = self._read_job(jid)
+            if j is None or j.status != "pending":
+                continue
+            if not self._try_lock("claim-" + jid):
+                continue
+            try:
+                j = self._read_job(jid)  # re-read under lock
+                if j is None or j.status != "pending":
+                    continue
+                j.status = "claimed"
+                j.worker_id = worker_id
+                j.attempts += 1
+                self._write_job(j)
+                return j
+            finally:
+                self._unlock("claim-" + jid)
+        return None
+
+    def complete_job(self, job_id: str, result: Any = None) -> None:
+        j = self._read_job(job_id)
+        if j is None:
+            raise KeyError(job_id)
+        j.status = "done"
+        j.result = result
+        self._write_job(j)
+
+    def fail_job(self, job_id: str, requeue: bool = True) -> None:
+        j = self._read_job(job_id)
+        if j is None:
+            raise KeyError(job_id)
+        j.status = "pending" if requeue else "failed"
+        j.worker_id = None
+        self._write_job(j)
+
+    def jobs(self, status: Optional[str] = None) -> List[Job]:
+        out = []
+        for jid in self._job_ids():
+            j = self._read_job(jid)
+            if j is not None and (status is None or j.status == status):
+                out.append(j)
+        return out
+
+    # -- heartbeats --
+    def _beat_path(self, worker_id: str) -> str:
+        return os.path.join(self.root, "beats", worker_id)
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._atomic_write(self._beat_path(worker_id), repr(time.time()))
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        try:
+            with open(self._beat_path(worker_id)) as f:
+                return float(f.read())
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def workers(self) -> List[str]:
+        return sorted(os.listdir(os.path.join(self.root, "beats")))
+
+    def evict_stale(self, timeout_s: float = 120.0) -> List[str]:
+        now = time.time()
+        stale = []
+        for w in self.workers():
+            t = self.last_heartbeat(w)
+            if t is None or now - t >= timeout_s:
+                stale.append(w)
+                try:
+                    os.unlink(self._beat_path(w))
+                except FileNotFoundError:
+                    pass
+        if stale:
+            dead = set(stale)
+            for j in self.jobs(status="claimed"):
+                if j.worker_id not in dead:
+                    continue
+                # requeue under the claim lock with a status re-check: a
+                # merely-slow worker may complete the job concurrently, and
+                # its result must not be clobbered back to pending
+                if not self._try_lock("claim-" + j.job_id):
+                    continue
+                try:
+                    cur = self._read_job(j.job_id)
+                    if (cur is not None and cur.status == "claimed"
+                            and cur.worker_id in dead):
+                        cur.status = "pending"
+                        cur.worker_id = None
+                        self._write_job(cur)
+                finally:
+                    self._unlock("claim-" + j.job_id)
+        return stale
+
+    # -- meta --
+    def put_meta(self, key: str, value: Any) -> None:
+        self._atomic_write(os.path.join(self.root, "meta", key + ".json"),
+                           json.dumps(value))
+
+    def get_meta(self, key: str, default: Any = None) -> Any:
+        try:
+            with open(os.path.join(self.root, "meta", key + ".json")) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return default

@@ -1,0 +1,177 @@
+"""Cluster runtime tests: state tracker job lifecycle, heartbeat eviction,
+fault-tolerant checkpoint/resume (the reference's MasterActor heartbeat +
+ModelSavingActor semantics, SURVEY §3.4/§5, tested in-process the way the
+reference uses BaseTestDistributed)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (
+    ClusterConfig,
+    FaultTolerantTrainer,
+    FileStateTracker,
+    HeartbeatMonitor,
+    InMemoryStateTracker,
+    initialize_distributed,
+)
+
+
+def toy(n=64, d=6, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.eye(c)[rng.integers(0, c, n)].astype(np.float32)
+    return DataSet(x, y)
+
+
+def make_net(seed=1):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+        .updater(Updater.ADAM).list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=12, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=12, n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(params=["memory", "file"])
+def tracker(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryStateTracker()
+    return FileStateTracker(str(tmp_path / "tracker"))
+
+
+class TestStateTracker:
+    def test_job_lifecycle(self, tracker):
+        jid = tracker.add_job({"batch": 0})
+        assert tracker.jobs(status="pending")[0].job_id == jid
+        j = tracker.claim_job("w1")
+        assert j.job_id == jid and j.worker_id == "w1" and j.attempts == 1
+        assert tracker.claim_job("w2") is None  # nothing left
+        tracker.complete_job(jid, result={"loss": 0.5})
+        done = tracker.jobs(status="done")
+        assert len(done) == 1 and done[0].result == {"loss": 0.5}
+
+    def test_fifo_claim_order(self, tracker):
+        ids = [tracker.add_job(i) for i in range(3)]
+        claimed = [tracker.claim_job("w").job_id for _ in range(3)]
+        assert claimed == ids
+
+    def test_fail_requeues(self, tracker):
+        jid = tracker.add_job("x")
+        tracker.claim_job("w1")
+        tracker.fail_job(jid, requeue=True)
+        j = tracker.claim_job("w2")
+        assert j.job_id == jid and j.attempts == 2
+
+    def test_fail_terminal(self, tracker):
+        jid = tracker.add_job("x")
+        tracker.claim_job("w1")
+        tracker.fail_job(jid, requeue=False)
+        assert tracker.claim_job("w2") is None
+        assert tracker.jobs(status="failed")[0].job_id == jid
+
+    def test_heartbeat_and_eviction_requeues_jobs(self, tracker):
+        jid = tracker.add_job("x")
+        tracker.heartbeat("w1")
+        tracker.claim_job("w1")
+        assert "w1" in tracker.workers()
+        assert tracker.evict_stale(timeout_s=60.0) == []  # fresh
+        time.sleep(0.05)
+        assert tracker.evict_stale(timeout_s=0.01) == ["w1"]
+        assert tracker.workers() == []
+        # the dead worker's claimed job went back to pending
+        j = tracker.claim_job("w2")
+        assert j.job_id == jid and j.attempts == 2
+
+    def test_meta_roundtrip(self, tracker):
+        tracker.put_meta("conf", {"lr": 0.1})
+        assert tracker.get_meta("conf") == {"lr": 0.1}
+        assert tracker.get_meta("missing", 42) == 42
+
+
+class TestHeartbeatMonitor:
+    def test_background_beats(self):
+        tracker = InMemoryStateTracker()
+        with HeartbeatMonitor(tracker, "w1", interval_s=0.02):
+            time.sleep(0.1)
+            t1 = tracker.last_heartbeat("w1")
+            time.sleep(0.1)
+            t2 = tracker.last_heartbeat("w1")
+        assert t1 is not None and t2 > t1
+        final = tracker.last_heartbeat("w1")
+        time.sleep(0.1)
+        assert tracker.last_heartbeat("w1") == final  # stopped
+
+
+class TestInitializeDistributed:
+    def test_single_process_noop(self):
+        assert initialize_distributed(ClusterConfig()) is False
+        assert initialize_distributed(
+            ClusterConfig(coordinator_address=None, num_processes=4)) is False
+
+
+class TestFaultTolerantTrainer:
+    def test_checkpoints_written_and_pruned(self, tmp_path):
+        net = make_net()
+        ft = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                  checkpoint_every=2, keep=2)
+        ds = toy()
+        for _ in range(7):
+            net.fit(ds)
+            if net.iteration_count % ft.every == 0:
+                ft.save()
+        assert len(ft.checkpoints()) == 2  # pruned to keep=2
+        assert ft.latest_checkpoint().endswith(
+            f"ckpt-{net.iteration_count - net.iteration_count % 2:012d}.zip"
+            if net.iteration_count % 2 else
+            f"ckpt-{net.iteration_count:012d}.zip")
+
+    def test_crash_resume_continues_identically(self, tmp_path):
+        ds = toy()
+        # uninterrupted run: 6 iterations
+        ref = make_net(seed=3)
+        for _ in range(6):
+            ref.fit(ds)
+
+        # interrupted run: 4 iterations, checkpoint, "crash", resume, 2 more
+        net1 = make_net(seed=3)
+        ft1 = FaultTolerantTrainer(net1, str(tmp_path / "ck"),
+                                   checkpoint_every=4)
+        for _ in range(4):
+            net1.fit(ds)
+        ft1.save()
+        del net1  # crash
+
+        net2 = make_net(seed=99)  # fresh process, different init
+        ft2 = FaultTolerantTrainer(net2, str(tmp_path / "ck"))
+        assert ft2.resume() is True
+        assert net2.iteration_count == 4
+        for _ in range(2):
+            net2.fit(ds)
+        np.testing.assert_allclose(
+            ref.get_flat_params(), net2.get_flat_params(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_resume_without_checkpoint(self, tmp_path):
+        net = make_net()
+        ft = FaultTolerantTrainer(net, str(tmp_path / "empty"))
+        assert ft.resume() is False
+
+    def test_fit_loop_heartbeats_and_saves(self, tmp_path):
+        tracker = InMemoryStateTracker()
+        net = make_net()
+        ft = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                  checkpoint_every=2, tracker=tracker,
+                                  worker_id="w-7")
+        ft.fit(toy(), num_epochs=1)
+        assert tracker.last_heartbeat("w-7") is not None
+        assert tracker.get_meta("latest_checkpoint") == ft.latest_checkpoint()
+        assert os.path.exists(ft.latest_checkpoint())

@@ -38,6 +38,13 @@ def data_dir() -> str:
 
 
 def _read_idx_images(path: str) -> np.ndarray:
+    if not path.endswith(".gz"):
+        # native C++ idx parser fast path
+        from deeplearning4j_tpu import native
+
+        arr = native.idx_to_array(path)
+        if arr is not None and arr.ndim == 3:
+            return arr[..., None] / 255.0
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic, n, rows, cols = struct.unpack(">IIII", f.read(16))

@@ -55,21 +55,47 @@ class CSVRecordReader(RecordReader):
         self.skip_lines = skip_lines
         self.delimiter = delimiter
         self._rows: Optional[List[List[str]]] = None
+        self._matrix: Optional[np.ndarray] = None  # all-numeric files
         self._pos = 0
 
     def _load(self):
-        if self._rows is None:
-            with open(self.path, newline="") as f:
-                rows = list(csv.reader(f, delimiter=self.delimiter))
-            self._rows = [r for r in rows[self.skip_lines:] if r]
+        if self._rows is not None or self._matrix is not None:
+            return
+        # All-numeric rectangular files parse to a float32 matrix (C++ fast
+        # path when available, numpy otherwise — same result either way);
+        # files with string cells / ragged rows stay lists of strings.
+        from deeplearning4j_tpu import native
+
+        mat = native.csv_to_array(self.path, self.delimiter, self.skip_lines)
+        if mat is not None:
+            self._matrix = mat
+            return
+        with open(self.path, newline="") as f:
+            rows = list(csv.reader(f, delimiter=self.delimiter))
+        rows = [r for r in rows[self.skip_lines:] if r]
+        if native.is_available():
+            # the native parser already rejected this file as non-numeric
+            self._rows = rows
+            return
+        try:
+            self._matrix = np.asarray(rows, np.float32)
+        except ValueError:
+            self._rows = rows
+
+    def _count(self) -> int:
+        return (len(self._matrix) if self._matrix is not None
+                else len(self._rows))
 
     def has_next(self):
         self._load()
-        return self._pos < len(self._rows)
+        return self._pos < self._count()
 
-    def next(self) -> List[str]:
+    def next(self):
+        """Next record: a float32 row for all-numeric files, a list of
+        strings otherwise."""
         self._load()
-        row = self._rows[self._pos]
+        row = (self._matrix[self._pos] if self._matrix is not None
+               else self._rows[self._pos])
         self._pos += 1
         return row
 
@@ -86,20 +112,38 @@ class SVMLightRecordReader(RecordReader):
         self.num_features = num_features
         self.zero_based = zero_based
         self._lines: Optional[List[str]] = None
+        self._native: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._pos = 0
 
     def _load(self):
-        if self._lines is None:
-            with open(self.path) as f:
-                self._lines = [l.strip() for l in f if l.strip()
-                               and not l.startswith("#")]
+        if self._lines is not None or self._native is not None:
+            return
+        from deeplearning4j_tpu import native
+
+        parsed = native.svmlight_to_arrays(self.path, self.num_features,
+                                           self.zero_based)
+        if parsed is not None:
+            self._native = parsed
+            return
+        with open(self.path) as f:
+            self._lines = [l.strip() for l in f if l.strip()
+                           and not l.startswith("#")]
+
+    def _count(self) -> int:
+        return (len(self._native[1]) if self._native is not None
+                else len(self._lines))
 
     def has_next(self):
         self._load()
-        return self._pos < len(self._lines)
+        return self._pos < self._count()
 
     def next(self) -> Tuple[float, np.ndarray]:
         self._load()
+        if self._native is not None:
+            feats, labels = self._native
+            i = self._pos
+            self._pos += 1
+            return float(labels[i]), feats[i]
         parts = self._lines[self._pos].split()
         self._pos += 1
         label = float(parts[0])
@@ -109,6 +153,11 @@ class SVMLightRecordReader(RecordReader):
                 break
             idx, val = tok.split(":")
             i = int(idx) - (0 if self.zero_based else 1)
+            if not 0 <= i < self.num_features:
+                raise ValueError(
+                    f"{self.path}: feature index {idx} out of range for "
+                    f"{self.num_features} features "
+                    f"({'zero' if self.zero_based else 'one'}-based)")
             x[i] = float(val)
         return label, x
 

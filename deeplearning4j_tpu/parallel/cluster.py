@@ -97,6 +97,7 @@ class HeartbeatMonitor:
     def start(self) -> "HeartbeatMonitor":
         if self._thread is not None:
             return self
+        self._stop = threading.Event()  # support stop() → start() restart
         self.tracker.heartbeat(self.worker_id)
 
         def run():

@@ -231,11 +231,15 @@ class FileStateTracker(StateTracker):
             os.close(fd)
             return True
         except FileExistsError:
-            # break locks abandoned by crashed processes (mtime-based; the
-            # unlink races benignly — O_EXCL arbitrates the re-create)
+            # break locks abandoned by crashed processes. Atomic rename
+            # arbitrates between concurrent breakers: only the process whose
+            # rename succeeds may recreate the lock, so a freshly re-created
+            # lock can never be blindly unlinked by a late breaker.
             try:
                 if time.time() - os.path.getmtime(path) >= self.LOCK_STALE_S:
-                    os.unlink(path)
+                    grave = path + ".stale-" + uuid.uuid4().hex[:8]
+                    os.rename(path, grave)  # only one renamer wins
+                    os.unlink(grave)
                     fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                     os.close(fd)
                     return True

@@ -1,0 +1,314 @@
+// Native host-side data runtime for deeplearning4j_tpu.
+//
+// Role: the CPU-bound ETL the reference delegated to native code (ND4J's
+// libnd4j + Canova record readers — SURVEY §2.2). The TPU compute path is
+// XLA; this library owns the host side: record parsing (CSV / SVMLight /
+// idx) and a threaded read-ahead file streamer backing the prefetch
+// pipeline (AsyncDataSetIterator role, datasets/iterator/
+// AsyncDataSetIterator.java:44).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// All buffers are malloc'd here and freed here; Python copies out into
+// numpy arrays and promptly frees the handle.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct FloatBuf {
+  std::vector<float> data;
+  std::vector<int64_t> dims;
+};
+
+// Read a whole file into memory. Returns false on IO error.
+bool read_file(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  if (n < 0) { std::fclose(f); return false; }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(n));
+  size_t got = n ? std::fread(&(*out)[0], 1, static_cast<size_t>(n), f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Generic float-buffer handle
+// ---------------------------------------------------------------------------
+
+const float* dl4j_buf_data(void* h) {
+  return static_cast<FloatBuf*>(h)->data.data();
+}
+
+int64_t dl4j_buf_size(void* h) {
+  return static_cast<int64_t>(static_cast<FloatBuf*>(h)->data.size());
+}
+
+int dl4j_buf_ndim(void* h) {
+  return static_cast<int>(static_cast<FloatBuf*>(h)->dims.size());
+}
+
+void dl4j_buf_dims(void* h, int64_t* out) {
+  FloatBuf* b = static_cast<FloatBuf*>(h);
+  for (size_t i = 0; i < b->dims.size(); ++i) out[i] = b->dims[i];
+}
+
+void dl4j_buf_free(void* h) { delete static_cast<FloatBuf*>(h); }
+
+// ---------------------------------------------------------------------------
+// CSV → dense [rows, cols] float matrix. Numeric cells only; returns nullptr
+// on ragged rows, non-numeric cells, or IO failure (caller falls back to the
+// Python text path).
+// ---------------------------------------------------------------------------
+
+void* dl4j_csv_parse(const char* path, char delim, int64_t skip_lines) {
+  std::string text;
+  if (!read_file(path, &text)) return nullptr;
+  FloatBuf* buf = new FloatBuf();
+  int64_t cols = -1, row_cols = 0, line_no = 0;
+  bool row_has_data = false;
+  const char* p = text.c_str();
+  const char* end = p + text.size();
+  const char* cell = p;
+
+  auto fail = [&]() -> void* { delete buf; return nullptr; };
+
+  auto flush_cell = [&](const char* cend) -> bool {
+    if (line_no < skip_lines) return true;
+    // empty trailing cell on an empty line: handled by caller
+    char* conv_end = nullptr;
+    // strtof needs NUL-terminated input; copy the (tiny) cell
+    std::string s(cell, cend);
+    // strip spaces
+    size_t a = s.find_first_not_of(" \t\r");
+    size_t b = s.find_last_not_of(" \t\r");
+    if (a == std::string::npos) return false;  // blank cell
+    s = s.substr(a, b - a + 1);
+    float v = std::strtof(s.c_str(), &conv_end);
+    if (conv_end != s.c_str() + s.size()) return false;  // non-numeric
+    buf->data.push_back(v);
+    ++row_cols;
+    row_has_data = true;
+    return true;
+  };
+
+  while (p <= end) {
+    char c = (p == end) ? '\n' : *p;
+    if (c == delim) {
+      if (!flush_cell(p)) return fail();
+      cell = p + 1;
+    } else if (c == '\n' || c == '\r') {
+      bool blank_line = (cell == p) && row_cols == 0;
+      if (!blank_line) {
+        if (!flush_cell(p)) return fail();
+      }
+      if (row_has_data) {
+        if (cols == -1) cols = row_cols;
+        else if (row_cols != cols) return fail();  // ragged
+      }
+      ++line_no;
+      row_cols = 0;
+      row_has_data = false;
+      // swallow \r\n pairs
+      if (c == '\r' && p + 1 < end && p[1] == '\n') ++p;
+      cell = p + 1;
+    }
+    ++p;
+  }
+  if (cols <= 0) return fail();
+  buf->dims = {static_cast<int64_t>(buf->data.size()) / cols, cols};
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// SVMLight "label idx:val ..." → dense features [rows, n_features] followed
+// by labels [rows] in one buffer (features first, then labels).
+// ---------------------------------------------------------------------------
+
+void* dl4j_svmlight_parse(const char* path, int64_t n_features,
+                          int zero_based) {
+  std::string text;
+  if (!read_file(path, &text)) return nullptr;
+  FloatBuf* buf = new FloatBuf();
+  std::vector<float> labels;
+  const char* p = text.c_str();
+  const char* end = p + text.size();
+
+  auto fail = [&]() -> void* { delete buf; return nullptr; };
+
+  while (p < end) {
+    // line bounds
+    const char* eol = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!eol) eol = end;
+    const char* q = p;
+    while (q < eol && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    if (q == eol || *q == '#') { p = eol + 1; continue; }  // blank/comment
+
+    char* conv = nullptr;
+    float label = std::strtof(q, &conv);
+    if (conv == q) return fail();
+    q = conv;
+    size_t base = buf->data.size();
+    buf->data.resize(base + static_cast<size_t>(n_features), 0.0f);
+    while (q < eol) {
+      while (q < eol && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+      if (q >= eol || *q == '#') break;
+      long idx = std::strtol(q, &conv, 10);
+      if (conv == q || conv >= eol || *conv != ':') return fail();
+      q = conv + 1;
+      float v = std::strtof(q, &conv);
+      if (conv == q) return fail();
+      q = conv;
+      long i = idx - (zero_based ? 0 : 1);
+      if (i < 0 || i >= n_features) return fail();
+      buf->data[base + static_cast<size_t>(i)] = v;
+    }
+    labels.push_back(label);
+    p = eol + 1;
+  }
+  int64_t rows = static_cast<int64_t>(labels.size());
+  buf->data.insert(buf->data.end(), labels.begin(), labels.end());
+  buf->dims = {rows, n_features};
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// idx (MNIST binary) → float buffer with dims from the header. Magic:
+// 0x00 0x00 <dtype> <ndim>; dims are big-endian int32; only dtype 0x08
+// (unsigned byte) is needed for MNIST.
+// ---------------------------------------------------------------------------
+
+void* dl4j_idx_parse(const char* path) {
+  std::string text;
+  if (!read_file(path, &text) || text.size() < 4) return nullptr;
+  const unsigned char* u = reinterpret_cast<const unsigned char*>(text.data());
+  if (u[0] != 0 || u[1] != 0) return nullptr;
+  unsigned dtype = u[2];
+  unsigned ndim = u[3];
+  if (dtype != 0x08 || ndim == 0 || ndim > 4) return nullptr;
+  if (text.size() < 4 + 4ull * ndim) return nullptr;
+  FloatBuf* buf = new FloatBuf();
+  int64_t total = 1;
+  for (unsigned d = 0; d < ndim; ++d) {
+    const unsigned char* q = u + 4 + 4 * d;
+    int64_t dim = (int64_t(q[0]) << 24) | (int64_t(q[1]) << 16) |
+                  (int64_t(q[2]) << 8) | int64_t(q[3]);
+    buf->dims.push_back(dim);
+    total *= dim;
+  }
+  if (static_cast<int64_t>(text.size()) < 4 + 4 * ndim + total) {
+    delete buf;
+    return nullptr;
+  }
+  buf->data.resize(static_cast<size_t>(total));
+  const unsigned char* body = u + 4 + 4 * ndim;
+  for (int64_t i = 0; i < total; ++i)
+    buf->data[static_cast<size_t>(i)] = static_cast<float>(body[i]);
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded read-ahead streamer: a background thread reads fixed-size chunks
+// of a binary file into a bounded ring so the host hides file latency from
+// the training loop (the AsyncDataSetIterator prefetch role, natively).
+// ---------------------------------------------------------------------------
+
+struct Stream {
+  FILE* f = nullptr;
+  int64_t chunk = 0;
+  size_t capacity = 0;
+  std::thread reader;
+  std::mutex mu;
+  std::condition_variable cv_pop, cv_push;
+  std::queue<std::vector<char>> q;
+  bool eof = false;
+  std::atomic<bool> stop{false};
+};
+
+static void stream_loop(Stream* s) {
+  for (;;) {
+    std::vector<char> block(static_cast<size_t>(s->chunk));
+    size_t got = std::fread(block.data(), 1, block.size(), s->f);
+    if (s->stop.load()) return;
+    block.resize(got);
+    {
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv_push.wait(lk, [s] { return s->q.size() < s->capacity ||
+                                       s->stop.load(); });
+      if (s->stop.load()) return;
+      if (got == 0) {
+        s->eof = true;
+        s->cv_pop.notify_all();
+        return;
+      }
+      s->q.push(std::move(block));
+      s->cv_pop.notify_one();
+    }
+    if (got < static_cast<size_t>(s->chunk)) {
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->eof = true;
+      s->cv_pop.notify_all();
+      return;
+    }
+  }
+}
+
+void* dl4j_stream_open(const char* path, int64_t chunk_bytes,
+                       int64_t capacity) {
+  if (chunk_bytes <= 0 || capacity <= 0) return nullptr;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  Stream* s = new Stream();
+  s->f = f;
+  s->chunk = chunk_bytes;
+  s->capacity = static_cast<size_t>(capacity);
+  s->reader = std::thread(stream_loop, s);
+  return s;
+}
+
+// Blocks until a chunk is ready; copies it into out (must hold chunk_bytes).
+// Returns bytes copied; 0 at EOF.
+int64_t dl4j_stream_next(void* h, char* out) {
+  Stream* s = static_cast<Stream*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv_pop.wait(lk, [s] { return !s->q.empty() || s->eof; });
+  if (s->q.empty()) return 0;
+  std::vector<char> block = std::move(s->q.front());
+  s->q.pop();
+  s->cv_push.notify_one();
+  lk.unlock();
+  std::memcpy(out, block.data(), block.size());
+  return static_cast<int64_t>(block.size());
+}
+
+void dl4j_stream_close(void* h) {
+  Stream* s = static_cast<Stream*>(h);
+  s->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->cv_push.notify_all();
+    s->cv_pop.notify_all();
+  }
+  if (s->reader.joinable()) s->reader.join();
+  std::fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -175,3 +175,28 @@ class TestFaultTolerantTrainer:
         assert tracker.last_heartbeat("w-7") is not None
         assert tracker.get_meta("latest_checkpoint") == ft.latest_checkpoint()
         assert os.path.exists(ft.latest_checkpoint())
+
+
+class TestReviewRegressions:
+    def test_heartbeat_monitor_restart(self):
+        tracker = InMemoryStateTracker()
+        m = HeartbeatMonitor(tracker, "w1", interval_s=0.02)
+        m.start(); time.sleep(0.05); m.stop()
+        m.start()
+        time.sleep(0.08)
+        t1 = tracker.last_heartbeat("w1")
+        time.sleep(0.08)
+        t2 = tracker.last_heartbeat("w1")
+        m.stop()
+        assert t2 > t1  # periodic beats resumed after restart
+
+    def test_stale_lock_broken_and_job_claimable(self, tmp_path):
+        tr = FileStateTracker(str(tmp_path / "t"))
+        jid = tr.add_job("x")
+        # simulate a crashed claimer: stale lock file left behind
+        lock = os.path.join(tr.root, "locks", "claim-" + jid)
+        open(lock, "w").close()
+        old = time.time() - 120
+        os.utime(lock, (old, old))
+        j = tr.claim_job("w2")
+        assert j is not None and j.job_id == jid

@@ -36,7 +36,7 @@ class TestRecordReaders:
         reader = CSVRecordReader(csv_file, skip_lines=1)
         rows = list(reader)
         assert len(rows) == 4
-        assert rows[0] == ["1.0", "2.0", "0"]
+        assert [float(v) for v in rows[0]] == [1.0, 2.0, 0.0]
         reader.reset()
         assert reader.has_next()
 

@@ -1,0 +1,177 @@
+"""C++ host runtime tests: parser parity vs the Python paths, streamer
+read-ahead, and graceful degradation (the native layer is an accelerator,
+never a behavior change)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    SVMLightRecordReader,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(), reason="native toolchain unavailable")
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    p = tmp_path / "data.csv"
+    rng = np.random.default_rng(0)
+    mat = rng.normal(size=(50, 5)).astype(np.float32)
+    with open(p, "w") as f:
+        f.write("a,b,c,d,e\n")  # header
+        for row in mat:
+            f.write(",".join(repr(float(v)) for v in row) + "\n")
+    return str(p), mat
+
+
+class TestCsv:
+    def test_parse_matches_numpy(self, csv_file):
+        path, mat = csv_file
+        out = native.csv_to_array(path, ",", skip_lines=1)
+        assert out is not None and out.shape == (50, 5)
+        np.testing.assert_allclose(out, mat, rtol=1e-6)
+
+    def test_non_numeric_returns_none(self, tmp_path):
+        p = tmp_path / "iris.csv"
+        p.write_text("1.0,2.0,setosa\n3.0,4.0,versicolor\n")
+        assert native.csv_to_array(str(p)) is None
+
+    def test_ragged_returns_none(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1,2,3\n4,5\n")
+        assert native.csv_to_array(str(p)) is None
+
+    def test_missing_file_returns_none(self, tmp_path):
+        assert native.csv_to_array(str(tmp_path / "nope.csv")) is None
+
+    def test_crlf_and_blank_lines(self, tmp_path):
+        p = tmp_path / "crlf.csv"
+        p.write_bytes(b"1,2\r\n\r\n3,4\r\n")
+        out = native.csv_to_array(str(p))
+        np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+    def test_reader_fast_path_matches_python(self, csv_file):
+        path, mat = csv_file
+        r = CSVRecordReader(path, skip_lines=1)
+        rows = [r.next() for _ in iter(r.has_next, False)]
+        assert len(rows) == 50
+        np.testing.assert_allclose(
+            np.asarray([[float(v) for v in row] for row in rows]),
+            mat, rtol=1e-6)
+
+
+class TestSvmLight:
+    def test_parse_matches_python_reader(self, tmp_path):
+        p = tmp_path / "data.svm"
+        p.write_text("1 1:0.5 3:2.0\n0 2:1.5\n# comment\n2 1:1 2:2 3:3 4:4\n")
+        feats, labels = native.svmlight_to_arrays(str(p), 4)
+        np.testing.assert_allclose(labels, [1, 0, 2])
+        np.testing.assert_allclose(
+            feats,
+            [[0.5, 0, 2.0, 0], [0, 1.5, 0, 0], [1, 2, 3, 4]])
+
+    def test_reader_uses_native(self, tmp_path):
+        p = tmp_path / "d.svm"
+        p.write_text("1 1:0.5 2:1.5\n0 2:3.0\n")
+        r = SVMLightRecordReader(str(p), num_features=2)
+        label, x = r.next()
+        assert r._native is not None  # fast path engaged
+        assert label == 1.0
+        np.testing.assert_allclose(x, [0.5, 1.5])
+
+    def test_out_of_range_index_returns_none(self, tmp_path):
+        p = tmp_path / "bad.svm"
+        p.write_text("1 7:0.5\n")
+        assert native.svmlight_to_arrays(str(p), 4) is None
+
+
+class TestIdx:
+    def test_mnist_style_images(self, tmp_path):
+        p = tmp_path / "images.idx3-ubyte"
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, (6, 4, 3), dtype=np.uint8)
+        with open(p, "wb") as f:
+            f.write(struct.pack(">BBBB", 0, 0, 0x08, 3))
+            f.write(struct.pack(">III", 6, 4, 3))
+            f.write(imgs.tobytes())
+        out = native.idx_to_array(str(p))
+        assert out.shape == (6, 4, 3)
+        np.testing.assert_allclose(out, imgs.astype(np.float32))
+
+    def test_labels_vector(self, tmp_path):
+        p = tmp_path / "labels.idx1-ubyte"
+        labels = np.asarray([3, 1, 4, 1, 5], np.uint8)
+        with open(p, "wb") as f:
+            f.write(struct.pack(">BBBB", 0, 0, 0x08, 1))
+            f.write(struct.pack(">I", 5))
+            f.write(labels.tobytes())
+        out = native.idx_to_array(str(p))
+        np.testing.assert_allclose(out, labels)
+
+    def test_truncated_returns_none(self, tmp_path):
+        p = tmp_path / "trunc.idx"
+        with open(p, "wb") as f:
+            f.write(struct.pack(">BBBB", 0, 0, 0x08, 1))
+            f.write(struct.pack(">I", 100))  # claims 100, has 0
+        assert native.idx_to_array(str(p)) is None
+
+
+class TestFileStreamer:
+    def test_reads_all_chunks_in_order(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        data = bytes(range(256)) * 40  # 10240 bytes
+        p.write_bytes(data)
+        got = b""
+        with native.FileStreamer(str(p), chunk_bytes=1024, capacity=3) as s:
+            for chunk in s:
+                got += chunk
+        assert got == data
+
+    def test_partial_final_chunk(self, tmp_path):
+        p = tmp_path / "odd.bin"
+        p.write_bytes(b"x" * 2500)
+        sizes = []
+        with native.FileStreamer(str(p), chunk_bytes=1000) as s:
+            for chunk in s:
+                sizes.append(len(chunk))
+        assert sizes == [1000, 1000, 500]
+
+    def test_early_close_no_hang(self, tmp_path):
+        p = tmp_path / "big.bin"
+        p.write_bytes(b"y" * 100_000)
+        s = native.FileStreamer(str(p), chunk_bytes=64, capacity=2)
+        assert s.next() is not None
+        s.close()  # reader thread blocked on full ring must exit
+
+
+class TestReviewRegressions:
+    def test_empty_svmlight_returns_empty_not_crash(self, tmp_path):
+        p = tmp_path / "empty.svm"
+        p.write_text("# only a comment\n\n")
+        out = native.svmlight_to_arrays(str(p), 4)
+        assert out is not None
+        feats, labels = out
+        assert feats.shape == (0, 4) and labels.shape == (0,)
+
+    def test_python_fallback_rejects_out_of_range_index(self, tmp_path):
+        p = tmp_path / "bad.svm"
+        p.write_text("1 0:5.0\n")  # index 0 in one-based mode
+        r = SVMLightRecordReader(str(p), num_features=4)
+        r._native = None  # force the Python path
+        r._lines = ["1 0:5.0"]
+        with pytest.raises(ValueError, match="out of range"):
+            r.next()
+
+    def test_csv_numeric_rows_are_floats_both_paths(self, tmp_path):
+        p = tmp_path / "num.csv"
+        p.write_text("1.5,2.5\n3.5,4.5\n")
+        r = CSVRecordReader(str(p))
+        row = r.next()
+        assert isinstance(row, np.ndarray) and row.dtype == np.float32
+        np.testing.assert_allclose(row, [1.5, 2.5])

@@ -294,6 +294,7 @@ class MultiLayerNetwork:
             )
         )
         self._score = loss  # device scalar; no sync (see score_value)
+        self._last_input = ds.features  # host ref for UI activation listeners
         return new_rnn
 
     def _solver_step(self, ds):

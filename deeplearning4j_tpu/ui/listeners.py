@@ -1,0 +1,241 @@
+"""Training listeners that feed the UI server.
+
+TPU-native equivalents of the reference's client-side UI listeners:
+``ui/weights/HistogramIterationListener.java`` (235 — POSTs a
+``ModelAndGradient`` JSON snapshot to ``/weights/update?sid=`` each
+iteration, :35-51,82-84), ``ui/weights/ConvolutionalIterationListener.java``
+(587 — tiles conv activations into a PNG) and
+``ui/flow/FlowIterationListener.java`` (428 — live architecture flowchart).
+
+Design differences from the reference, driven by the XLA execution model:
+reading params/score forces a device→host sync, so every listener runs at a
+stride (``frequency``); the "gradient" panel reports the applied parameter
+update ``Δθ`` between listener firings (the optimizer-adapted gradient
+direction actually taken) rather than re-running backprop host-side, keeping
+the jitted train step untouched.
+
+Listeners can talk to an in-process ``UiServer`` directly (no HTTP) or to a
+remote one over HTTP — the wire format is identical.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+import urllib.request
+import zlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+HIST_BINS = 30
+
+
+class RemoteUiConnection:
+    """POSTs JSON payloads to a UI server URL (the Jersey-client role in
+    HistogramIterationListener.java:35-51)."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    def post(self, route: str, payload: Any, sid: str) -> None:
+        req = urllib.request.Request(
+            f"{self.base_url}{route}?sid={sid}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            resp.read()
+
+
+class _UiListener(IterationListener):
+    """Shared plumbing: accept a UiServer instance or a URL."""
+
+    def __init__(self, server=None, url: Optional[str] = None,
+                 session_id: str = "default", frequency: int = 1):
+        if server is None and url is None:
+            from deeplearning4j_tpu.ui.server import UiServer
+
+            server = UiServer.get_instance()
+        self._server = server
+        self._conn = RemoteUiConnection(url) if url else None
+        self.session_id = session_id
+        self.frequency = max(1, int(frequency))
+
+    def _post(self, kind_route: str, kind: str, payload: Any) -> None:
+        if self._conn is not None:
+            self._conn.post(kind_route, payload, self.session_id)
+        else:
+            self._server.post_update(kind, payload, sid=self.session_id)
+
+
+def _array_stats(arr: np.ndarray) -> Dict[str, Any]:
+    arr = np.asarray(arr, np.float64).ravel()
+    counts, edges = np.histogram(arr, bins=HIST_BINS)
+    return {
+        "mean": float(arr.mean()),
+        "std": float(arr.std()),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+        "l2": float(np.linalg.norm(arr)),
+        "histogram": {"counts": counts.tolist(),
+                      "edges": [float(edges[0]), float(edges[-1])]},
+    }
+
+
+class HistogramIterationListener(_UiListener):
+    """Param/update histograms + score → /weights/update
+    (HistogramIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1, **kw):
+        super().__init__(frequency=frequency, **kw)
+        self._prev_table: Optional[Dict[str, np.ndarray]] = None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency:
+            return
+        table = {k: np.asarray(v) for k, v in model.get_param_table().items()}
+        payload: Dict[str, Any] = {
+            "iteration": iteration,
+            "score": float(model.score_value),
+            "parameters": {k: _array_stats(v) for k, v in table.items()},
+        }
+        if self._prev_table is not None:
+            updates = {
+                k: _array_stats(v - self._prev_table[k])
+                for k, v in table.items() if k in self._prev_table
+            }
+            payload["gradients"] = updates  # applied update Δθ (see module doc)
+        self._prev_table = table
+        self._post("/weights/update", "weights", payload)
+
+
+class FlowIterationListener(_UiListener):
+    """Architecture flowchart + per-layer param counts → /flow/update
+    (FlowIterationListener.java:428)."""
+
+    def __init__(self, frequency: int = 10, **kw):
+        super().__init__(frequency=frequency, **kw)
+
+    @staticmethod
+    def describe(model) -> Dict[str, Any]:
+        conf = model.conf
+        nodes, edges = [], []
+        table = model.get_param_table()
+        counts: Dict[str, int] = {}
+        for name, arr in table.items():
+            lid = name.split("_", 1)[0]
+            counts[lid] = counts.get(lid, 0) + int(np.asarray(arr).size)
+        if hasattr(conf, "layers") and isinstance(conf.layers, dict):
+            # ComputationGraph: layers keyed by name + explicit vertex DAG
+            for name in conf.topological_order:
+                v = conf.vertices.get(name)
+                kind = (type(conf.layers[name]).__name__
+                        if name in conf.layers else
+                        type(v).__name__ if v is not None else "Input")
+                nodes.append({"name": name, "type": kind,
+                              "params": counts.get(name, 0)})
+                for src in (getattr(v, "inputs", None) or []):
+                    edges.append({"from": src, "to": name})
+        else:
+            prev = "input"
+            nodes.append({"name": "input", "type": "Input", "params": 0})
+            for i, lc in enumerate(conf.layers):
+                name = f"{i}_{type(lc).__name__}"
+                nodes.append({"name": name, "type": type(lc).__name__,
+                              "params": counts.get(str(i), 0)})
+                edges.append({"from": prev, "to": name})
+                prev = name
+        return {"nodes": nodes, "edges": edges}
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency:
+            return
+        payload = self.describe(model)
+        payload["iteration"] = iteration
+        payload["score"] = float(model.score_value)
+        self._post("/flow/update", "flow", payload)
+
+
+class ConvolutionalIterationListener(_UiListener):
+    """Tiles the first conv layer's activation maps on the last training
+    batch into a base64 PNG → /activations/update
+    (ConvolutionalIterationListener.java:587)."""
+
+    def __init__(self, frequency: int = 10, layer_index: Optional[int] = None,
+                 max_channels: int = 16, max_rows: int = 4, **kw):
+        super().__init__(frequency=frequency, **kw)
+        self.layer_index = layer_index
+        self.max_channels = max_channels
+        self.max_rows = max_rows
+
+    def _find_conv_layer(self, model) -> Optional[int]:
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        if self.layer_index is not None:
+            return self.layer_index
+        for i, lc in enumerate(model.conf.layers):
+            if isinstance(lc, L.ConvolutionLayer):
+                return i
+        return None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency:
+            return
+        x = getattr(model, "_last_input", None)
+        if x is None:
+            return
+        li = self._find_conv_layer(model)
+        if li is None:
+            return
+        acts = model.feed_forward(np.asarray(x)[: self.max_rows])
+        a = np.asarray(acts[li + 1])  # feed_forward[0] is the input
+        if a.ndim != 4:
+            return
+        tile = _tile_activations(a, self.max_channels)
+        payload = {
+            "iteration": iteration,
+            "layer": li,
+            "shape": list(a.shape),
+            "image": "data:image/png;base64,"
+                     + base64.b64encode(encode_png_gray(tile)).decode(),
+        }
+        self._post("/activations/update", "activations", payload)
+
+
+def _tile_activations(a: np.ndarray, max_channels: int) -> np.ndarray:
+    """(N,H,W,C) activations → one uint8 grid image (rows=examples,
+    cols=channels)."""
+    n, h, w, c = a.shape
+    c = min(c, max_channels)
+    grid = np.zeros((n * (h + 1), c * (w + 1)), np.uint8)
+    for i in range(n):
+        for j in range(c):
+            img = a[i, :, :, j].astype(np.float64)
+            lo, hi = img.min(), img.max()
+            img = (img - lo) / (hi - lo) if hi > lo else np.zeros_like(img)
+            grid[i * (h + 1): i * (h + 1) + h,
+                 j * (w + 1): j * (w + 1) + w] = (img * 255).astype(np.uint8)
+    return grid
+
+
+def encode_png_gray(img: np.ndarray) -> bytes:
+    """Minimal 8-bit grayscale PNG encoder (stdlib zlib only — the reference
+    leaned on javax.imageio for the same job)."""
+    img = np.ascontiguousarray(img, np.uint8)
+    h, w = img.shape
+
+    def chunk(kind: bytes, data: bytes) -> bytes:
+        return (struct.pack(">I", len(data)) + kind + data
+                + struct.pack(">I", zlib.crc32(kind + data) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # 8-bit grayscale
+    raw = b"".join(b"\x00" + img[r].tobytes() for r in range(h))
+    return (b"\x89PNG\r\n\x1a\n"
+            + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw, 6))
+            + chunk(b"IEND", b""))

@@ -1,0 +1,179 @@
+"""UI server + listeners (reference: deeplearning4j-ui module — UiServer,
+WeightResource/FlowResource/ActivationsResource/NearestNeighborsResource,
+HistogramIterationListener, ConvolutionalIterationListener,
+FlowIterationListener)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    ConvolutionalIterationListener,
+    FlowIterationListener,
+    HistogramIterationListener,
+    UiServer,
+    encode_png_gray,
+)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = UiServer(port=0)
+    yield s
+    s.stop()
+
+
+def _dense_net():
+    conf = (
+        NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+        .updater(Updater.SGD).list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _iris_like(rng, n=32):
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_histogram_listener_roundtrip(server, rng):
+    net = _dense_net()
+    net.set_listeners(HistogramIterationListener(
+        server=server, session_id="hist-test"))
+    ds = _iris_like(rng)
+    for _ in range(3):
+        net.fit(ds)
+
+    data = _get(f"{server.url}/weights/data?sid=hist-test")
+    assert data["iteration"] == 3
+    assert np.isfinite(data["score"])
+    assert "0_W" in data["parameters"] and "1_b" in data["parameters"]
+    stats = data["parameters"]["0_W"]
+    assert len(stats["histogram"]["counts"]) == 30
+    assert stats["l2"] > 0
+    # update ("gradient") panel appears from the 2nd firing on
+    assert "gradients" in data and "0_W" in data["gradients"]
+
+    hist = _get(f"{server.url}/weights/history?sid=hist-test")
+    assert [row["iteration"] for row in hist] == [1, 2, 3]
+    assert all(np.isfinite(row["score"]) for row in hist)
+
+
+def test_histogram_listener_over_http(server, rng):
+    net = _dense_net()
+    net.set_listeners(HistogramIterationListener(
+        url=server.url, session_id="http-test"))
+    net.fit(_iris_like(rng))
+    data = _get(f"{server.url}/weights/data?sid=http-test")
+    assert data["iteration"] == 1
+    assert "http-test" in _get(f"{server.url}/sessions")
+
+
+def test_flow_listener(server, rng):
+    net = _dense_net()
+    net.set_listeners(FlowIterationListener(
+        server=server, session_id="flow-test", frequency=1))
+    net.fit(_iris_like(rng))
+    flow = _get(f"{server.url}/flow/data?sid=flow-test")
+    names = [n["name"] for n in flow["nodes"]]
+    assert names[0] == "input"
+    assert any("DenseLayer" in n for n in names)
+    assert any("OutputLayer" in n for n in names)
+    assert len(flow["edges"]) == 2
+    # param counts: dense 4*8+8, output 8*3+3
+    by_name = {n["name"]: n["params"] for n in flow["nodes"]}
+    assert by_name["0_DenseLayer"] == 4 * 8 + 8
+    assert by_name["1_OutputLayer"] == 8 * 3 + 3
+
+
+def test_conv_listener_posts_png(server, rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(0).learning_rate(0.01).list()
+        .layer(0, L.ConvolutionLayer(n_in=1, n_out=4, kernel_size=(3, 3),
+                                     stride=(1, 1), activation="relu"))
+        .layer(1, L.OutputLayer(n_in=4 * 26 * 26, n_out=10))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ConvolutionalIterationListener(
+        server=server, session_id="conv-test", frequency=1, max_rows=2))
+    x = rng.random((4, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    net.fit(DataSet(x, y))
+    act = _get(f"{server.url}/activations/data?sid=conv-test")
+    assert act["image"].startswith("data:image/png;base64,")
+    assert act["layer"] == 0
+    assert act["shape"][0] == 2  # max_rows examples tiled
+
+
+def test_nearest_neighbors_endpoint(server, rng):
+    vecs = np.eye(4, dtype=np.float32) + 0.01 * rng.normal(size=(4, 4))
+    labels = ["alpha", "beta", "gamma", "delta"]
+    out = _post(f"{server.url}/nearestneighbors/upload",
+                {"labels": labels, "vectors": vecs.tolist()})
+    assert out["count"] == 4
+    hits = _get(f"{server.url}/nearestneighbors?word=alpha&k=2")
+    assert len(hits) == 2
+    assert hits[0]["word"] != "alpha"
+    assert hits[0]["distance"] <= hits[1]["distance"]
+    assert _get(f"{server.url}/nearestneighbors?word=unknown&k=2") == []
+
+
+def test_tsne_and_api_endpoints(server):
+    _post(f"{server.url}/tsne/upload?sid=t",
+          {"coords": [[0.0, 1.0], [1.0, 0.0]], "labels": ["a", "b"]})
+    got = _get(f"{server.url}/tsne/coords?sid=t")
+    assert got["labels"] == ["a", "b"]
+    _post(f"{server.url}/api/update?sid=t", {"hello": "world"})
+    assert _get(f"{server.url}/api/data?sid=t") == {"hello": "world"}
+
+
+def test_dashboard_and_404(server):
+    with urllib.request.urlopen(server.url + "/", timeout=5) as r:
+        body = r.read().decode()
+    assert "tpu-dl4j training UI" in body
+    with pytest.raises(urllib.error.HTTPError):
+        _get(server.url + "/nope")
+
+
+def test_png_encoder_valid():
+    img = (np.arange(64, dtype=np.uint8).reshape(8, 8) * 4).astype(np.uint8)
+    png = encode_png_gray(img)
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    # decodable by PIL if available; otherwise just check IHDR dims
+    import struct
+    w, h = struct.unpack(">II", png[16:24])
+    assert (w, h) == (8, 8)
+    try:
+        from PIL import Image
+        import io
+
+        arr = np.asarray(Image.open(io.BytesIO(png)))
+        assert arr.shape == (8, 8)
+        np.testing.assert_array_equal(arr, img)
+    except ImportError:
+        pass

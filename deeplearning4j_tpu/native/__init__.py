@@ -32,12 +32,20 @@ _load_failed = False
 
 
 def _build() -> bool:
+    # compile to a private temp path, then atomically publish: concurrent
+    # processes (multi-host launcher workers) must never dlopen a torn .so
+    tmp = f"{_SO}.build-{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        os.replace(tmp, _SO)
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -171,16 +179,21 @@ class FileStreamer:
             raise RuntimeError("native library unavailable")
         self._lib = lib
         self.chunk_bytes = chunk_bytes
+        # one reusable receive buffer: next() calls are serialized per
+        # streamer, and a fresh create_string_buffer per chunk would zero +
+        # copy every chunk twice on the hot prefetch path
+        self._buf = ctypes.create_string_buffer(chunk_bytes)
         self._h = lib.dl4j_stream_open(path.encode(), chunk_bytes, capacity)
         if not self._h:
             raise OSError(f"cannot stream {path}")
 
     def next(self) -> Optional[bytes]:
-        buf = ctypes.create_string_buffer(self.chunk_bytes)
-        got = self._lib.dl4j_stream_next(self._h, buf)
+        if self._h is None:  # closed: C side would deref NULL
+            return None
+        got = self._lib.dl4j_stream_next(self._h, self._buf)
         if got == 0:
             return None
-        return buf.raw[:got]
+        return self._buf.raw[:got]
 
     def __iter__(self):
         while (b := self.next()) is not None:
@@ -196,3 +209,10 @@ class FileStreamer:
 
     def __exit__(self, *exc):
         self.close()
+
+    def __del__(self):
+        # a dropped streamer must release the C++ reader thread + FILE*
+        try:
+            self.close()
+        except Exception:
+            pass

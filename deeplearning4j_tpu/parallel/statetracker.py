@@ -21,6 +21,7 @@ on failure — JobFailed/ClearWorker protocol, actor/core/protocol/).
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import tempfile
@@ -182,17 +183,16 @@ class FileStateTracker(StateTracker):
     Layout: ``<root>/jobs/<id>.json``, ``<root>/beats/<worker>``,
     ``<root>/meta/<key>.json``. All writes are atomic (tempfile + rename on
     the same filesystem), so concurrent readers never see partial JSON.
-    Claims use exclusive-create lock files (``O_EXCL``) — the same
-    first-writer-wins discipline the reference gets from Hazelcast
-    distributed locks.
+    Claims use kernel advisory locks (``flock``) on per-job lock files — the
+    same first-writer-wins discipline the reference gets from Hazelcast
+    distributed locks, with crash-release handled by the kernel (a dead
+    process's lock vanishes with its fd, so no stale-lock breaking is
+    needed and no two claimers can ever hold the same job).
     """
-
-    #: claim locks are held only for the claim/requeue transaction; any lock
-    #: older than this belongs to a crashed process and may be broken
-    LOCK_STALE_S = 60.0
 
     def __init__(self, root: str):
         self.root = root
+        self._lock_fds: Dict[str, int] = {}
         for sub in ("jobs", "beats", "meta", "locks", "tmp"):
             os.makedirs(os.path.join(root, sub), exist_ok=True)
 
@@ -226,32 +226,20 @@ class FileStateTracker(StateTracker):
 
     def _try_lock(self, name: str) -> bool:
         path = os.path.join(self.root, "locks", name)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR)
         try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
             os.close(fd)
-            return True
-        except FileExistsError:
-            # break locks abandoned by crashed processes. Atomic rename
-            # arbitrates between concurrent breakers: only the process whose
-            # rename succeeds may recreate the lock, so a freshly re-created
-            # lock can never be blindly unlinked by a late breaker.
-            try:
-                if time.time() - os.path.getmtime(path) >= self.LOCK_STALE_S:
-                    grave = path + ".stale-" + uuid.uuid4().hex[:8]
-                    os.rename(path, grave)  # only one renamer wins
-                    os.unlink(grave)
-                    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                    os.close(fd)
-                    return True
-            except (FileNotFoundError, FileExistsError):
-                pass
             return False
+        self._lock_fds[name] = fd
+        return True
 
     def _unlock(self, name: str) -> None:
-        try:
-            os.unlink(os.path.join(self.root, "locks", name))
-        except FileNotFoundError:
-            pass
+        fd = self._lock_fds.pop(name, None)
+        if fd is not None:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
 
     # -- jobs --
     def add_job(self, payload: Any, job_id: Optional[str] = None) -> str:

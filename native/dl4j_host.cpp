@@ -204,12 +204,19 @@ void* dl4j_idx_parse(const char* path) {
   unsigned ndim = u[3];
   if (dtype != 0x08 || ndim == 0 || ndim > 4) return nullptr;
   if (text.size() < 4 + 4ull * ndim) return nullptr;
+  // the payload can never exceed the file size, so a corrupt header whose
+  // dims multiply past it (or overflow) must fall back to the Python parser
+  const int64_t max_total = static_cast<int64_t>(text.size());
   FloatBuf* buf = new FloatBuf();
   int64_t total = 1;
   for (unsigned d = 0; d < ndim; ++d) {
     const unsigned char* q = u + 4 + 4 * d;
     int64_t dim = (int64_t(q[0]) << 24) | (int64_t(q[1]) << 16) |
                   (int64_t(q[2]) << 8) | int64_t(q[3]);
+    if (dim < 0 || (dim > 0 && total > max_total / dim)) {
+      delete buf;
+      return nullptr;
+    }
     buf->dims.push_back(dim);
     total *= dim;
   }

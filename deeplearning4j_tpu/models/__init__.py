@@ -13,3 +13,4 @@ from deeplearning4j_tpu.models.zoo import (  # noqa: F401
     resnet18,
     transformer_lm,
 )
+from deeplearning4j_tpu.models.rntn import RNTN  # noqa: F401

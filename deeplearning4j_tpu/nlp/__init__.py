@@ -23,4 +23,5 @@ from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.trees import Tree, build_word_index  # noqa: F401
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401

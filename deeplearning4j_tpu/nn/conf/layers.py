@@ -361,6 +361,19 @@ class AutoEncoder(LayerConf):
 
 @register_layer_conf
 @dataclasses.dataclass
+class RecursiveAutoEncoder(LayerConf):
+    """Recursive autoencoder (nn/layers/feedforward/autoencoder/recursive/
+    RecursiveAutoEncoder.java, 162): folds a (batch, time, n_in) sequence
+    left-to-right through a shared encoder, accumulating a per-fold
+    reconstruction loss; forward output is the root encoding (batch, n_out).
+    The fold is a ``lax.scan`` — one compiled program per sequence length."""
+
+    loss_function: LossFunction = LossFunction.MSE
+    activation: str = "tanh"
+
+
+@register_layer_conf
+@dataclasses.dataclass
 class RBM(LayerConf):
     """Restricted Boltzmann machine (nn/layers/feedforward/rbm/RBM.java:68,
     CD-k at :101). Gibbs sampling uses functional PRNG keys threaded through

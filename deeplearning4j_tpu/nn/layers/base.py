@@ -29,7 +29,7 @@ Params = Dict[str, jnp.ndarray]
 State = Dict[str, jnp.ndarray]
 
 # exact leaf names treated as biases (unregularized; bias_learning_rate)
-_BIAS_PARAM_NAMES = frozenset({"b", "vb", "hb", "beta", "bias"})
+_BIAS_PARAM_NAMES = frozenset({"b", "vb", "hb", "be", "bd", "beta", "bias"})
 
 
 def is_bias_param(name: str) -> bool:

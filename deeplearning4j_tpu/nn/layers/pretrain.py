@@ -67,6 +67,77 @@ class AutoEncoderImpl(LayerImpl):
         return compute_loss(conf.loss_function, z, x)
 
 
+@register_layer_impl(L.RecursiveAutoEncoder)
+class RecursiveAutoEncoderImpl(LayerImpl):
+    """Recursive autoencoder (RecursiveAutoEncoder.java, 162 LoC).
+
+    Folds a sequence left-to-right from a zero root: p₀ = 0;
+    pᵢ = act(W_e·[pᵢ₋₁; xᵢ] + b_e), with per-fold reconstruction
+    [p̂; x̂] = act(W_d·pᵢ + b_d) scored against [pᵢ₋₁; xᵢ] under the layer's
+    ``loss_function``. The fold is a ``lax.scan``; forward returns the root
+    encoding. Masked timesteps (variable-length series) hold the carry and
+    contribute no reconstruction loss. Rank-2 inputs are length-1 sequences.
+    """
+
+    def init_params(self, key):
+        conf = self.conf
+        policy = get_policy()
+        d_in, d = conf.n_in, conf.n_out
+        k_e, k_d = jax.random.split(key)
+        return {
+            "We": init_weights(k_e, (d + d_in, d), conf.weight_init.value,
+                               distribution=conf.dist,
+                               dtype=policy.param_dtype),
+            "be": jnp.full((d,), conf.bias_init, policy.param_dtype),
+            "Wd": init_weights(k_d, (d, d + d_in), conf.weight_init.value,
+                               distribution=conf.dist,
+                               dtype=policy.param_dtype),
+            "bd": jnp.zeros((d + d_in,), policy.param_dtype),
+        }
+
+    def _fold(self, params, x, mask=None):
+        """x: (batch, time, n_in), mask: (batch, time) or None →
+        (root (batch, n_out), mean per-step recon loss over unmasked steps)."""
+        act = self.activation_fn()
+        d = self.conf.n_out
+        batch, t = x.shape[0], x.shape[1]
+        p0 = jnp.zeros((batch, d), x.dtype)
+        if mask is None:
+            mask_t = jnp.ones((t, batch), x.dtype)
+        else:
+            mask_t = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+
+        def step(p, inputs):
+            xt, mt = inputs
+            cc = jnp.concatenate([p, xt], axis=-1)
+            p_new = act(cc @ params["We"] + params["be"])
+            recon = act(p_new @ params["Wd"] + params["bd"])
+            p_next = jnp.where(mt[:, None] > 0, p_new, p)  # hold at masked
+            return p_next, (recon, cc)
+
+        root, (recons, ccs) = lax.scan(step, p0, (jnp.swapaxes(x, 0, 1),
+                                                  mask_t))
+        feat = recons.shape[-1]
+        return root, compute_loss(
+            self.conf.loss_function, recons.reshape(t * batch, feat),
+            ccs.reshape(t * batch, feat), mask=mask_t.reshape(t * batch))
+
+    def forward(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        if x.ndim == 2:
+            x = x[:, None, :]
+            mask = None
+        root, _ = self._fold(params, x, mask=mask)
+        return root, state
+
+    def pretrain_loss(self, params, x, rng: jax.Array, mask=None):
+        if x.ndim == 2:
+            x = x[:, None, :]
+            mask = None
+        _, err = self._fold(params, x, mask=mask)
+        return err
+
+
 @register_layer_impl(L.RBM)
 class RBMImpl(LayerImpl):
     def init_params(self, key):

@@ -45,7 +45,7 @@ from deeplearning4j_tpu.nn.updater import (
 from deeplearning4j_tpu.ops.losses import compute_loss
 
 _RECURRENT_CONFS = (L.GravesLSTM, L.GravesBidirectionalLSTM, L.GRU, L.LSTM)
-_PRETRAIN_CONFS = (L.RBM, L.AutoEncoder)
+_PRETRAIN_CONFS = (L.RBM, L.AutoEncoder, L.RecursiveAutoEncoder)
 
 
 class MultiLayerNetwork:

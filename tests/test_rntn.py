@@ -1,0 +1,217 @@
+"""Trees, RNTN, RecursiveAutoEncoder (reference: models/rntn/RNTN.java,
+text/corpora/treeparser/, autoencoder/recursive/RecursiveAutoEncoder.java;
+gradient-check style follows deeplearning4j-graph DeepWalkGradientCheck)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.models.rntn import RNTN
+from deeplearning4j_tpu.nlp.trees import Tree, build_word_index, pad_to_bucket
+
+
+PTB = "(3 (2 (2 the) (2 movie)) (4 (3 rocks) (2 .)))"
+
+
+class TestTree:
+    def test_parse_roundtrip_structure(self):
+        t = Tree.parse(PTB)
+        assert t.label == 3
+        assert t.words() == ["the", "movie", "rocks", "."]
+        assert not t.is_leaf
+        assert t.num_nodes() == 7  # 4 leaves + 3 internal
+        assert t.depth() == 2
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            Tree.parse("(3 (2 a) (2 b)) trailing")
+
+    def test_parse_many(self):
+        trees = Tree.parse_many(PTB + "\n\n" + PTB)
+        assert len(trees) == 2
+
+    def test_from_tokens_right_branching(self):
+        t = Tree.from_tokens(["a", "b", "c"], label=1)
+        assert t.words() == ["a", "b", "c"]
+        # right-branching: root = (a, (b, c))
+        assert t.children[0].word == "a"
+        assert t.children[1].children[0].word == "b"
+
+    def test_binarize_ternary(self):
+        t = Tree(label=0, children=[Tree(label=0, word=w)
+                                    for w in "abc"])
+        b = t.binarize()
+        assert all(len(n.children) == 2 for n in b.post_order()
+                   if not n.is_leaf)
+        assert b.words() == ["a", "b", "c"]
+
+    def test_linearize_program(self):
+        t = Tree.parse(PTB)
+        vocab = build_word_index([t])
+        prog = t.linearize(vocab, max_nodes=8)
+        assert prog["left"].shape == (8,)
+        n = int(prog["n_nodes"])
+        assert n == 7
+        # post-order: children always evaluated before parents
+        for i in range(n):
+            if prog["is_leaf"][i] == 0:
+                assert prog["left"][i] < i and prog["right"][i] < i
+        # padding labeled -1
+        assert prog["label"][7] == -1
+        # root is the last real node with the top label
+        assert prog["label"][n - 1] == 3
+
+    def test_linearize_too_small_raises(self):
+        t = Tree.parse(PTB)
+        with pytest.raises(ValueError):
+            t.linearize(build_word_index([t]), max_nodes=3)
+
+    def test_pad_to_bucket(self):
+        assert pad_to_bucket(3) == 8
+        assert pad_to_bucket(9) == 16
+        assert pad_to_bucket(1000) == 1000
+
+
+def _toy_trees():
+    """Tiny sentiment corpus: class 1 = positive words, 0 = negative."""
+    pos = ["(1 (1 good) (1 movie))", "(1 (1 great) (1 film))",
+           "(1 (1 good) (1 film))", "(1 (1 great) (1 movie))"]
+    neg = ["(0 (0 bad) (0 movie))", "(0 (0 awful) (0 film))",
+           "(0 (0 bad) (0 film))", "(0 (0 awful) (0 movie))"]
+    return [Tree.parse(s) for s in pos + neg]
+
+
+class TestRNTN:
+    def test_fit_reduces_loss_and_predicts(self):
+        trees = _toy_trees()
+        model = RNTN(num_hidden=6, num_classes=2, learning_rate=0.1,
+                     l2=0.0, seed=0).init(trees)
+        before = model.score(trees)
+        model.fit(trees, num_epochs=60, batch_size=8)
+        after = model.score(trees)
+        assert after < before * 0.5, (before, after)
+        assert model.predict_root(Tree.parse("(1 (1 good) (1 movie))")) == 1
+        assert model.predict_root(Tree.parse("(0 (0 awful) (0 film))")) == 0
+
+    def test_predict_shapes_and_vectors(self):
+        trees = _toy_trees()
+        model = RNTN(num_hidden=4, num_classes=2, seed=1).init(trees)
+        t = trees[0]
+        preds = model.predict(t)
+        assert preds.shape == (3,)  # 2 leaves + root
+        vecs = model.node_vectors(t)
+        assert vecs.shape == (3, 4)
+        assert np.all(np.isfinite(vecs))
+        assert model.get_word_vector("good").shape == (4,)
+
+    def test_no_tensor_mode(self):
+        trees = _toy_trees()
+        model = RNTN(num_hidden=4, num_classes=2, use_tensors=False,
+                     seed=0).init(trees)
+        loss = model.fit(trees, num_epochs=2)
+        assert np.isfinite(loss)
+
+    def test_gradient_check(self):
+        """Central-difference check of the tree-scan loss (GradientCheckUtil
+        pattern, f64)."""
+        trees = _toy_trees()[:2]
+        model = RNTN(num_hidden=3, num_classes=2, l2=1e-3, seed=2).init(trees)
+        batch, _ = model._batch_programs(trees)
+
+        with jax.enable_x64(True):
+            params = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(np.asarray(p), jnp.float64),
+                model.params)
+            grads = jax.grad(model._loss)(params, batch)
+            eps = 1e-6
+            for key in ("W", "T", "Ws", "L"):
+                flat = np.asarray(params[key], np.float64).ravel()
+                if flat.size == 0:
+                    continue
+                idx = [0, flat.size // 2, flat.size - 1]
+                for i in idx:
+                    bumped = flat.copy(); bumped[i] += eps
+                    p_plus = dict(params); p_plus[key] = jnp.asarray(
+                        bumped.reshape(params[key].shape))
+                    bumped2 = flat.copy(); bumped2[i] -= eps
+                    p_minus = dict(params); p_minus[key] = jnp.asarray(
+                        bumped2.reshape(params[key].shape))
+                    num = (float(model._loss(p_plus, batch))
+                           - float(model._loss(p_minus, batch))) / (2 * eps)
+                    ana = float(np.asarray(grads[key]).ravel()[i])
+                    denom = max(abs(num), abs(ana), 1e-8)
+                    assert abs(num - ana) / denom < 1e-4, (key, i, num, ana)
+
+
+class TestRecursiveAutoEncoder:
+    def _net(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+            .updater(Updater.ADAGRAD).list()
+            .layer(0, L.RecursiveAutoEncoder(n_in=5, n_out=4,
+                                             activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=4, n_out=2))
+            .pretrain(True)
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_conf_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.layers import LayerConf
+
+        lc = L.RecursiveAutoEncoder(n_in=5, n_out=4)
+        again = LayerConf.from_dict(lc.to_dict())
+        assert isinstance(again, L.RecursiveAutoEncoder)
+        assert again.n_out == 4
+
+    def test_forward_rank2_and_rank3(self, rng):
+        net = self._net()
+        out2 = np.asarray(net.output(rng.normal(size=(3, 5)).astype(np.float32)))
+        assert out2.shape == (3, 2)
+        # rank-3 sequence folds to a root then classifies
+        out3 = np.asarray(net.output(
+            rng.normal(size=(3, 6, 5)).astype(np.float32)))
+        assert out3.shape == (3, 2)
+
+    def test_mask_holds_carry(self, rng):
+        """Padded timesteps under a feature mask must not change the root
+        encoding (same semantics as the recurrent layers)."""
+        import jax.numpy as jnp
+
+        net = self._net()
+        impl = net.layers[0]
+        p = net.params["0"]
+        x_short = rng.normal(size=(2, 3, 5)).astype(np.float32)
+        x_padded = np.concatenate(
+            [x_short, rng.normal(size=(2, 2, 5)).astype(np.float32)], axis=1)
+        mask = np.array([[1, 1, 1, 0, 0]] * 2, np.float32)
+        root_short, _ = impl._fold(p, jnp.asarray(x_short))
+        root_masked, _ = impl._fold(p, jnp.asarray(x_padded),
+                                    mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(root_short),
+                                   np.asarray(root_masked), atol=1e-6)
+
+    def test_pretrain_reduces_reconstruction(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.layers.base import get_layer_impl
+
+        net = self._net()
+        x = rng.normal(size=(16, 6, 5)).astype(np.float32) * 0.5
+        impl = net.layers[0]
+        p0 = {k: np.asarray(v) for k, v in net.params["0"].items()}
+        before = float(impl.pretrain_loss(net.params["0"], jnp.asarray(x),
+                                          jax.random.PRNGKey(0)))
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        for _ in range(30):
+            net.pretrain([DataSet(x, y)])
+        after = float(impl.pretrain_loss(net.params["0"], jnp.asarray(x),
+                                         jax.random.PRNGKey(0)))
+        assert after < before, (before, after)
+        # pretraining actually moved the encoder weights
+        assert not np.allclose(p0["We"], np.asarray(net.params["0"]["We"]))

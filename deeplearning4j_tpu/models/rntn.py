@@ -73,8 +73,11 @@ class RNTN:
                 lambda p, s: p - s.astype(p.dtype), params, steps)
             return new_params, new_state, loss
 
-        # jit caches one executable per padded tree-size bucket
+        # jit caches one executable per padded tree-size bucket; the
+        # evaluators share the same per-bucket cache discipline
         self._train_step = jax.jit(_step, donate_argnums=(0, 1))
+        self._loss_fn = jax.jit(self._loss)
+        self._eval_fn = jax.jit(self._forward_tree)
 
     # -- init ----------------------------------------------------------
     def init(self, trees: Optional[Sequence[Tree]] = None) -> "RNTN":
@@ -178,7 +181,7 @@ class RNTN:
 
     def score(self, trees: Sequence[Tree]) -> float:
         batch, _ = self._batch_programs(trees)
-        return float(self._loss(self.params, batch))
+        return float(self._loss_fn(self.params, batch))
 
     def _single_program(self, tree: Tree):
         prog = tree.linearize(self.vocab)
@@ -193,7 +196,7 @@ class RNTN:
     def predict(self, tree: Tree) -> np.ndarray:
         """Per-node class predictions in post-order (root last)."""
         dev, n = self._single_program(tree)
-        _, logits = self._forward_tree(self.params, dev)
+        _, logits = self._eval_fn(self.params, dev)
         return np.asarray(jnp.argmax(logits[:n], axis=-1))
 
     def predict_root(self, tree: Tree) -> int:
@@ -201,7 +204,7 @@ class RNTN:
 
     def node_vectors(self, tree: Tree) -> np.ndarray:
         dev, n = self._single_program(tree)
-        buf, _ = self._forward_tree(self.params, dev)
+        buf, _ = self._eval_fn(self.params, dev)
         return np.asarray(buf[:n])
 
     def get_word_vector(self, word: str) -> np.ndarray:

@@ -75,13 +75,15 @@ class Tree:
 
         def rec() -> Tree:
             nonlocal pos
+            if pos >= len(tokens):
+                raise ValueError(f"unbalanced tree (truncated input): {s!r}")
             if tokens[pos] != "(":
                 raise ValueError(f"expected '(' at token {pos}: {tokens[pos]}")
             pos += 1
             label_tok = tokens[pos]
             pos += 1
             node = Tree(label=int(label_tok) if _is_int(label_tok) else None)
-            while tokens[pos] != ")":
+            while pos < len(tokens) and tokens[pos] != ")":
                 if tokens[pos] == "(":
                     node.children.append(rec())
                 else:  # leaf word
@@ -92,6 +94,8 @@ class Tree:
                             f"multi-word leaves must be nested nodes")
                     node.word = tokens[pos]
                     pos += 1
+            if pos >= len(tokens):
+                raise ValueError(f"unbalanced tree (missing ')'): {s!r}")
             pos += 1
             return node
 

@@ -1,0 +1,118 @@
+"""ML pipeline API (reference: dl4j-spark-ml — MultiLayerNetworkClassification,
+MultiLayerNetworkReconstruction, Unsupervised, spark.ml Pipeline usage)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ml import (
+    NeuralNetClassification,
+    NeuralNetReconstruction,
+    NeuralNetUnsupervised,
+    Pipeline,
+    StandardScaler,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+
+
+def _blobs(rng, n=96, d=4, classes=3, spread=4.0):
+    centers = rng.normal(size=(classes, d)) * spread
+    labels = rng.integers(0, classes, n)
+    x = centers[labels] + rng.normal(size=(n, d)) * 0.5
+    return {"features": x.astype(np.float32), "label": labels}
+
+
+def _clf_conf(d=4, classes=3):
+    return (
+        NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+        .updater(Updater.ADAM).list()
+        .layer(0, L.DenseLayer(n_in=d, n_out=16, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=16, n_out=classes))
+        .build()
+    )
+
+
+class TestParams:
+    def test_get_set_copy(self):
+        est = NeuralNetClassification(_clf_conf(), epochs=3)
+        assert est.get("epochs") == 3
+        est.set("epochs", 5)
+        assert est.get("epochs") == 5
+        clone = est.copy(epochs=9)
+        assert clone.get("epochs") == 9 and est.get("epochs") == 5
+
+    def test_set_unknown_raises(self):
+        with pytest.raises(KeyError):
+            NeuralNetClassification(_clf_conf()).set("nope", 1)
+
+
+class TestClassification:
+    def test_fit_transform_accuracy(self, rng):
+        data = _blobs(rng)
+        model = NeuralNetClassification(_clf_conf(), epochs=30,
+                                        batch_size=32).fit(data)
+        out = model.transform(data)
+        assert "prediction" in out and "probability" in out
+        assert out["probability"].shape == (96, 3)
+        acc = (out["prediction"] == data["label"]).mean()
+        assert acc > 0.9, acc
+        # input dict not mutated (withColumn semantics)
+        assert "prediction" not in data
+        # predict() shortcut agrees with transform
+        np.testing.assert_array_equal(model.predict(data["features"]),
+                                      out["prediction"])
+
+    def test_one_hot_labels_accepted(self, rng):
+        data = _blobs(rng)
+        data = {"features": data["features"],
+                "label": np.eye(3, dtype=np.float32)[data["label"]]}
+        model = NeuralNetClassification(_clf_conf(), epochs=5).fit(data)
+        assert model.transform(data)["prediction"].shape == (96,)
+
+
+class TestPipeline:
+    def test_scaler_then_classifier(self, rng):
+        data = _blobs(rng, spread=50.0)  # unscaled features are huge
+        pipe = Pipeline([
+            StandardScaler(),
+            NeuralNetClassification(_clf_conf(), epochs=30, batch_size=32),
+        ])
+        model = pipe.fit(data)
+        out = model.transform(data)
+        acc = (out["prediction"] == data["label"]).mean()
+        assert acc > 0.9, acc
+
+    def test_bad_stage_raises(self):
+        with pytest.raises(TypeError):
+            Pipeline([object()]).fit({"features": np.zeros((2, 2))})
+
+
+class TestReconstructionAndUnsupervised:
+    def _ae_conf(self, d=6):
+        return (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+            .updater(Updater.ADAGRAD).list()
+            .layer(0, L.AutoEncoder(n_in=d, n_out=3, corruption_level=0.0,
+                                    activation="sigmoid"))
+            .layer(1, L.OutputLayer(n_in=3, n_out=d,
+                                    activation="sigmoid"))
+            .pretrain(True).backprop(False)
+            .build()
+        )
+
+    def test_reconstruction_column(self, rng):
+        x = (rng.random((64, 6)) > 0.5).astype(np.float32)
+        data = {"features": x}
+        model = NeuralNetReconstruction(self._ae_conf(), epochs=5,
+                                        layer_index=0).fit(data)
+        out = model.transform(data)
+        assert out["reconstruction"].shape == (64, 3)  # hidden code
+        assert np.all(np.isfinite(out["reconstruction"]))
+
+    def test_unsupervised_embedding(self, rng):
+        x = (rng.random((64, 6)) > 0.5).astype(np.float32)
+        model = NeuralNetUnsupervised(self._ae_conf(), epochs=3).fit(
+            {"features": x})
+        out = model.transform({"features": x})
+        assert out["embedding"].shape[0] == 64
+        assert np.all(np.isfinite(out["embedding"]))

@@ -24,4 +24,18 @@ from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord  # noqa:
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
 from deeplearning4j_tpu.nlp.trees import Tree, build_word_index  # noqa: F401
+from deeplearning4j_tpu.nlp.viterbi import Viterbi  # noqa: F401
+from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex  # noqa: F401
+from deeplearning4j_tpu.nlp.sentiwordnet import SWN3  # noqa: F401
+from deeplearning4j_tpu.nlp.movingwindow import (  # noqa: F401
+    Window,
+    moving_window_matrix,
+    window_indices,
+    windows,
+)
+from deeplearning4j_tpu.nlp.stopwords import (  # noqa: F401
+    get_stop_words,
+    is_stop_word,
+    remove_stop_words,
+)
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401

@@ -1,0 +1,90 @@
+"""Viterbi decoder — most-likely label sequence under a Markov model.
+
+Re-design of ``deeplearning4j-core/.../util/Viterbi.java`` (196 LoC), which
+decodes label sequences from per-step outcome scores with a host-side DP
+loop. Here the max-product recursion is a ``lax.scan`` over time with a
+device backtrace, vmappable over a batch of sequences — the DP table never
+leaves the device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Viterbi:
+    """Decoder over ``num_states`` labels (Viterbi.java's possibleLabels).
+
+    ``transitions``: [S, S] log-potentials (from → to); defaults to uniform
+    (pure per-step argmax with tie-keeping dynamics, the reference's
+    metastability-style default). ``initial``: [S] log-prior.
+    """
+
+    def __init__(self, num_states: int,
+                 transitions: Optional[np.ndarray] = None,
+                 initial: Optional[np.ndarray] = None):
+        self.num_states = num_states
+        self.transitions = jnp.asarray(
+            np.zeros((num_states, num_states), np.float32)
+            if transitions is None else np.asarray(transitions, np.float32))
+        if self.transitions.shape != (num_states, num_states):
+            raise ValueError("transitions must be [S, S]")
+        self.initial = jnp.asarray(
+            np.zeros((num_states,), np.float32) if initial is None
+            else np.asarray(initial, np.float32))
+        self._decode = jax.jit(self._decode_impl)
+        self._decode_batch = jax.jit(jax.vmap(self._decode_impl))
+
+    def _decode_impl(self, emissions: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """emissions: [T, S] log-scores → (path [T] int32, log-score)."""
+        trans = self.transitions
+
+        def step(delta, emit_t):
+            # delta: [S] best score ending in each state
+            scores = delta[:, None] + trans  # [from, to]
+            best_prev = jnp.argmax(scores, axis=0)  # [to]
+            delta_new = jnp.max(scores, axis=0) + emit_t
+            return delta_new, best_prev
+
+        delta0 = self.initial + emissions[0]
+        delta_T, backptrs = lax.scan(step, delta0, emissions[1:])
+        last = jnp.argmax(delta_T)
+        score = delta_T[last]
+
+        def back(state, ptr_t):
+            prev = ptr_t[state]
+            return prev, state
+
+        first, rest = lax.scan(back, last, backptrs, reverse=True)
+        path = jnp.concatenate([jnp.asarray([first]), rest])
+        return path.astype(jnp.int32), score
+
+    # -- public API -----------------------------------------------------
+    def decode(self, emissions) -> Tuple[np.ndarray, float]:
+        """Decode one sequence of per-step label log-scores [T, S]."""
+        e = jnp.asarray(np.asarray(emissions, np.float32))
+        if e.ndim != 2 or e.shape[1] != self.num_states:
+            raise ValueError(f"emissions must be [T, {self.num_states}]")
+        path, score = self._decode(e)
+        return np.asarray(path), float(score)
+
+    def decode_batch(self, emissions) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode a batch [B, T, S] → (paths [B, T], scores [B])."""
+        e = jnp.asarray(np.asarray(emissions, np.float32))
+        paths, scores = self._decode_batch(e)
+        return np.asarray(paths), np.asarray(scores)
+
+    @staticmethod
+    def from_counts(transition_counts: np.ndarray,
+                    smoothing: float = 1.0) -> "Viterbi":
+        """Build from observed transition counts (add-k smoothed log-probs),
+        the way the reference derives probabilities from label statistics."""
+        c = np.asarray(transition_counts, np.float64) + smoothing
+        logp = np.log(c / c.sum(axis=1, keepdims=True))
+        return Viterbi(c.shape[0], transitions=logp)

@@ -1,0 +1,189 @@
+"""Viterbi, inverted index, moving windows, stop words, SWN3 (reference:
+util/Viterbi.java, text/invertedindex/LuceneInvertedIndex.java,
+text/movingwindow/, text/stopwords/StopWords.java, sentiwordnet/SWN3.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex
+from deeplearning4j_tpu.nlp.movingwindow import (
+    BEGIN,
+    END,
+    moving_window_matrix,
+    window_indices,
+    windows,
+)
+from deeplearning4j_tpu.nlp.sentiwordnet import SWN3
+from deeplearning4j_tpu.nlp.stopwords import (
+    get_stop_words,
+    is_stop_word,
+    remove_stop_words,
+)
+from deeplearning4j_tpu.nlp.viterbi import Viterbi
+
+
+class TestViterbi:
+    def test_argmax_when_uniform_transitions(self):
+        v = Viterbi(3)
+        emissions = np.log(np.array([[0.7, 0.2, 0.1],
+                                     [0.1, 0.8, 0.1],
+                                     [0.2, 0.1, 0.7]], np.float32))
+        path, score = v.decode(emissions)
+        np.testing.assert_array_equal(path, [0, 1, 2])
+        assert np.isfinite(score)
+
+    def test_transitions_override_emissions(self):
+        # sticky transitions: staying is much cheaper than switching
+        trans = np.log(np.array([[0.95, 0.05], [0.05, 0.95]], np.float32))
+        v = Viterbi(2, transitions=trans)
+        # emissions weakly prefer flip-flopping 0,1,0,1
+        e = np.log(np.array([[0.6, 0.4], [0.45, 0.55],
+                             [0.6, 0.4], [0.45, 0.55]], np.float32))
+        path, _ = v.decode(e)
+        np.testing.assert_array_equal(path, [0, 0, 0, 0])
+
+    def test_exhaustive_agreement(self):
+        """DP result equals brute-force max over all 3^4 paths."""
+        rng = np.random.default_rng(0)
+        S, T = 3, 4
+        trans = rng.normal(size=(S, S)).astype(np.float32)
+        init = rng.normal(size=(S,)).astype(np.float32)
+        e = rng.normal(size=(T, S)).astype(np.float32)
+        v = Viterbi(S, transitions=trans, initial=init)
+        path, score = v.decode(e)
+
+        import itertools
+
+        def path_score(p):
+            s = init[p[0]] + e[0, p[0]]
+            for t in range(1, T):
+                s += trans[p[t - 1], p[t]] + e[t, p[t]]
+            return s
+
+        best = max(itertools.product(range(S), repeat=T), key=path_score)
+        assert abs(score - path_score(best)) < 1e-4
+        np.testing.assert_array_equal(path, best)
+
+    def test_batch_decode(self):
+        v = Viterbi(2)
+        e = np.log(np.array([[[0.9, 0.1]] * 3, [[0.1, 0.9]] * 3], np.float32))
+        paths, scores = v.decode_batch(e)
+        np.testing.assert_array_equal(paths, [[0, 0, 0], [1, 1, 1]])
+        assert scores.shape == (2,)
+
+    def test_from_counts(self):
+        counts = np.array([[8, 2], [1, 9]], np.float64)
+        v = Viterbi.from_counts(counts)
+        assert v.transitions.shape == (2, 2)
+        assert float(v.transitions[0, 0]) > float(v.transitions[0, 1])
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError):
+            Viterbi(3).decode(np.zeros((4, 2), np.float32))
+
+
+class TestInvertedIndex:
+    def _index(self):
+        ix = InvertedIndex()
+        ix.add_words_to_doc(0, ["the", "cat", "sat"], label="a")
+        ix.add_words_to_doc(1, ["the", "dog", "sat", "sat"], label="b")
+        ix.add_words_to_doc(2, ["a", "bird"], label="a")
+        return ix
+
+    def test_postings_and_counts(self):
+        ix = self._index()
+        assert ix.documents("sat") == [0, 1]
+        assert ix.documents("bird") == [2]
+        assert ix.documents("unknown") == []
+        assert ix.num_documents() == 3
+        assert ix.num_documents("the") == 2
+        assert ix.doc_frequency("sat") == 2
+        assert ix.label(1) == "b"
+
+    def test_duplicate_doc_rejected(self):
+        ix = self._index()
+        with pytest.raises(KeyError):
+            ix.add_words_to_doc(0, ["x"])
+
+    def test_add_doc_autoid(self):
+        ix = self._index()
+        new_id = ix.add_doc(["new", "doc"])
+        assert new_id == 3
+        assert ix.document(3) == ["new", "doc"]
+
+    def test_tfidf_rare_word_scores_higher(self):
+        ix = self._index()
+        scores = ix.tfidf(0)
+        assert scores["cat"] > scores["the"]  # "the" in 2 docs, "cat" in 1
+
+    def test_batch_iter(self):
+        ix = self._index()
+        batches = list(ix.batch_iter(2))
+        assert [len(b) for b in batches] == [2, 1]
+        shuffled = list(ix.batch_iter(2, shuffle=True, seed=0))
+        assert sum(len(b) for b in shuffled) == 3
+
+
+class TestMovingWindow:
+    def test_windows_padding_and_focus(self):
+        ws = windows(["i", "like", "cats"], window_size=3)
+        assert len(ws) == 3
+        assert ws[0].words == [BEGIN, "i", "like"]
+        assert ws[0].focus_word == "i"
+        assert ws[2].words == ["like", "cats", END]
+        assert ws[2].focus_word == "cats"
+
+    def test_even_window_rejected(self):
+        with pytest.raises(ValueError):
+            windows(["a"], window_size=4)
+
+    def test_window_indices(self):
+        vocab = {"<s>": 0, "i": 1, "like": 2, "cats": 3, "</s>": 4}
+        idx = window_indices(["i", "like", "cats"], vocab, window_size=3)
+        assert idx.shape == (3, 3)
+        np.testing.assert_array_equal(idx[0], [0, 1, 2])
+        np.testing.assert_array_equal(idx[2], [2, 3, 4])
+
+    def test_moving_window_matrix(self):
+        x = np.arange(12).reshape(4, 3)
+        m = moving_window_matrix(x, 2)
+        assert m.shape == (3, 2, 3)
+        np.testing.assert_array_equal(m[0], x[:2])
+        aug = moving_window_matrix(x, 2, add_rotations=True)
+        assert aug.shape == (6, 2, 3)
+        with pytest.raises(ValueError):
+            moving_window_matrix(x, 9)
+
+
+class TestStopWords:
+    def test_basics(self):
+        assert is_stop_word("The")
+        assert not is_stop_word("neural")
+        assert "the" in get_stop_words()
+        assert remove_stop_words(["the", "neural", "net", "is", "good"]) == \
+            ["neural", "net", "good"]
+
+
+class TestSWN3:
+    def test_word_scores(self):
+        swn = SWN3()
+        assert swn.extract("good") > 0
+        assert swn.extract("awful") < 0
+        assert swn.extract("xylophone") == 0.0
+
+    def test_classify_bands(self):
+        swn = SWN3()
+        assert swn.classify(["excellent", "wonderful"]) == "strong_positive"
+        assert swn.classify(["terrible", "horrible"]) == "strong_negative"
+        assert swn.classify(["table", "chair"]) == "neutral"
+        assert swn.class_for_score(0.5) == "positive"
+        assert swn.class_for_score(-0.5) == "negative"
+
+    def test_load_custom_lexicon(self, tmp_path):
+        p = tmp_path / "swn.txt"
+        p.write_text("# comment\na\t1\t0.9\t0.1\tshiny#1\n"
+                     "a\t2\t0.0\t1.0\tgrim#1 grim#2\n")
+        swn = SWN3(str(p))
+        assert swn.extract("shiny") == pytest.approx(0.8)
+        assert swn.extract("grim") == pytest.approx(-1.0)
+        assert swn.extract("good") == 0.0  # builtin not loaded

@@ -57,33 +57,39 @@ class InvertedIndex:
             self._insert(doc_id, words, label)
         return doc_id
 
-    # -- lookups --------------------------------------------------------
+    # -- lookups (locked: concurrent indexing must not break iteration) --
     def document(self, doc_id: int) -> List[str]:
-        return list(self._docs[doc_id])
+        with self._lock:
+            return list(self._docs[doc_id])
 
     def label(self, doc_id: int) -> Optional[str]:
-        return self._labels[doc_id]
+        with self._lock:
+            return self._labels[doc_id]
 
     def documents(self, word: str) -> List[int]:
-        return list(self._postings.get(word, []))
+        with self._lock:
+            return list(self._postings.get(word, []))
 
     def num_documents(self, word: Optional[str] = None) -> int:
-        if word is None:
-            return len(self._docs)
-        return len(self._postings.get(word, []))
+        with self._lock:
+            if word is None:
+                return len(self._docs)
+            return len(self._postings.get(word, []))
 
     def terms(self) -> List[str]:
-        return sorted(self._postings)
+        with self._lock:
+            return sorted(self._postings)
 
     def doc_frequency(self, word: str) -> int:
-        return len(self._postings.get(word, []))
+        with self._lock:
+            return len(self._postings.get(word, []))
 
     def idf(self, word: str) -> float:
-        n, df = len(self._docs), self.doc_frequency(word)
+        n, df = self.num_documents(), self.doc_frequency(word)
         return math.log((1 + n) / (1 + df)) + 1.0
 
     def tfidf(self, doc_id: int) -> Dict[str, float]:
-        doc = self._docs[doc_id]
+        doc = self.document(doc_id)
         out: Dict[str, float] = {}
         for w in doc:
             out[w] = out.get(w, 0.0) + 1.0
@@ -92,13 +98,16 @@ class InvertedIndex:
 
     # -- batching (the word2vec-feeding role) ---------------------------
     def each_doc(self) -> Iterator[List[str]]:
-        for doc_id in list(self._doc_ids):
+        with self._lock:
+            ids = list(self._doc_ids)
+        for doc_id in ids:
             yield self.document(doc_id)
 
     def batch_iter(self, batch_size: int,
                    shuffle: bool = False,
                    seed: Optional[int] = None) -> Iterator[List[List[str]]]:
-        ids = list(self._doc_ids)
+        with self._lock:
+            ids = list(self._doc_ids)
         if shuffle:
             random.Random(seed).shuffle(ids)
         for i in range(0, len(ids), batch_size):

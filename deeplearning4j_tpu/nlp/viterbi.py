@@ -77,6 +77,10 @@ class Viterbi:
     def decode_batch(self, emissions) -> Tuple[np.ndarray, np.ndarray]:
         """Decode a batch [B, T, S] → (paths [B, T], scores [B])."""
         e = jnp.asarray(np.asarray(emissions, np.float32))
+        if e.ndim != 3 or e.shape[2] != self.num_states:
+            raise ValueError(
+                f"emissions must be [B, T, {self.num_states}], "
+                f"got {e.shape}")
         paths, scores = self._decode_batch(e)
         return np.asarray(paths), np.asarray(scores)
 

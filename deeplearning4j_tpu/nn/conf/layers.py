@@ -349,6 +349,20 @@ class LSTM(BaseRecurrentConf):
 
 @register_layer_conf
 @dataclasses.dataclass
+class ImageLSTM(BaseRecurrentConf):
+    """Image-captioning LSTM (nn/layers/recurrent/ImageLSTM.java, 503 LoC —
+    "based on Karpathy et al.'s work on generation of image descriptions"):
+    an image representation is consumed as the first timestep conditioning
+    an LSTM over word vectors, with a projection to the output vocabulary
+    at every step and beam-search decoding. ``hidden_size`` defaults to
+    ``n_out`` when unset; params mirror the reference's RW (combined
+    input+recurrent gate weights), W (hidden→output), b."""
+
+    hidden_size: Optional[int] = None
+
+
+@register_layer_conf
+@dataclasses.dataclass
 class AutoEncoder(LayerConf):
     """Denoising autoencoder (nn/layers/feedforward/autoencoder/
     AutoEncoder.java): corruption_level = input dropout noise for pretraining."""

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -202,3 +203,139 @@ class GRUImpl(LayerImpl):
         if "h" in state:
             new_state["h"] = hT
         return jnp.swapaxes(ys, 0, 1), new_state
+
+
+@register_layer_impl(L.ImageLSTM)
+class ImageLSTMImpl(LayerImpl):
+    """Image-captioning LSTM (ImageLSTM.java:54, "based on Karpathy et al.").
+
+    Params follow the reference's ImageLSTMParamInitializer: ``RW``
+    ([n_in + hidden, 4·hidden] combined input+recurrent gate weights, the
+    reference's RECURRENT_WEIGHT_KEY at :58), ``W`` ([hidden, n_out] output
+    projection), ``b`` ([n_out]). Forward runs the gate recurrence as a
+    ``lax.scan`` and projects every step to the output space; decoding is a
+    host-driven beam search (the reference's BeamSearch inner class :282)
+    around a jitted single-step cell.
+    """
+
+    def _hidden(self) -> int:
+        return self.conf.hidden_size or self.conf.n_out
+
+    def init_params(self, key):
+        conf = self.conf
+        policy = get_policy()
+        n_in, hid, n_out = conf.n_in, self._hidden(), conf.n_out
+        k1, k2 = jax.random.split(key)
+        RW = init_weights(k1, (n_in + hid, 4 * hid), conf.weight_init.value,
+                          fan_in=n_in + hid, fan_out=hid,
+                          distribution=conf.dist, dtype=policy.param_dtype)
+        W = init_weights(k2, (hid, n_out), conf.weight_init.value,
+                         distribution=conf.dist, dtype=policy.param_dtype)
+        gate_bias = jnp.zeros((4 * hid,), policy.param_dtype)
+        gate_bias = gate_bias.at[hid:2 * hid].set(conf.forget_gate_bias_init)
+        return {"RW": RW, "gb": gate_bias,
+                "W": W, "b": jnp.zeros((n_out,), policy.param_dtype)}
+
+    def _gates(self, z, c, act):
+        hid = self._hidden()
+        i = jax.nn.sigmoid(z[:, :hid])
+        f = jax.nn.sigmoid(z[:, hid:2 * hid])
+        o = jax.nn.sigmoid(z[:, 2 * hid:3 * hid])
+        g = act(z[:, 3 * hid:])
+        c_new = f * c + i * g
+        h_new = o * act(c_new)
+        return h_new, c_new
+
+    def _cell(self, params, x_t, h, c):
+        """One gate step (beam-search decoding): x_t [b, n_in],
+        h/c [b, hid] → (h', c')."""
+        z = jnp.concatenate([x_t, h], axis=-1) @ params["RW"] + params["gb"]
+        return self._gates(z, c, self.activation_fn())
+
+    def forward(self, params, x, state, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        policy = get_policy()
+        act = self.activation_fn()
+        b, t, _ = x.shape
+        n_in = self.conf.n_in
+        hid = self._hidden()
+        # hoist the input half of the combined RW GEMM over all timesteps
+        # (one [b·t, n_in] @ [n_in, 4h] MXU matmul), as _lstm_scan does;
+        # only the recurrent half runs per scan step
+        RW_in = policy.cast_compute(params["RW"][:n_in])
+        RW_rec = policy.cast_compute(params["RW"][n_in:])
+        xW = policy.cast_compute(x).reshape(b * t, n_in) @ RW_in
+        xW = policy.cast_output(xW).reshape(b, t, 4 * hid) + params["gb"]
+        h0 = state.get("h")
+        c0 = state.get("c")
+        h = jnp.zeros((b, hid), xW.dtype) if h0 is None else h0
+        c = jnp.zeros((b, hid), xW.dtype) if c0 is None else c0
+        if mask is None:
+            mask_t = jnp.ones((t, b, 1), xW.dtype)
+        else:
+            mask_t = jnp.swapaxes(mask.astype(xW.dtype), 0, 1)[..., None]
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            z_t, m = inp
+            z = z_t + policy.cast_output(
+                policy.cast_compute(h_prev) @ RW_rec)
+            h_new, c_new = self._gates(z, c_prev, act)
+            h_new = m * h_new + (1.0 - m) * h_prev
+            c_new = m * c_new + (1.0 - m) * c_prev
+            return (h_new, c_new), h_new
+
+        (hT, cT), hs = lax.scan(step, (h, c),
+                                (jnp.swapaxes(xW, 0, 1), mask_t))
+        ys = jnp.swapaxes(hs, 0, 1) @ params["W"] + params["b"]
+        ys = ys * jnp.swapaxes(mask_t, 0, 1)  # masked steps output zero
+        new_state = dict(state)
+        if "h" in state:
+            new_state["h"] = hT
+            new_state["c"] = cT
+        return ys, new_state
+
+    # -- decoding (BeamSearch, ImageLSTM.java:282) ----------------------
+    def beam_search(self, params, xi, word_vectors, n_steps: int = 20,
+                    beam_width: int = 3, end_token: Optional[int] = None):
+        """Decode token sequences conditioned on image representation ``xi``.
+
+        ``xi``: [n_in] image embedding consumed as step 0;
+        ``word_vectors``: [n_out, n_in] input vector per output token (the
+        reference's ``ws``). Returns [(tokens, log_prob)] sorted best-first.
+
+        Decodes THIS layer's output projection — train with a parameterless
+        head (``LossLayer(activation="softmax")``) so the decoded
+        distribution is exactly the trained one; under further
+        parameterized layers, decode from the full network instead.
+        """
+        if not hasattr(self, "_jit_cell"):
+            self._jit_cell = jax.jit(
+                lambda p, x_t, h, c: self._cell(p, x_t, h, c))
+        hid = self._hidden()
+        h = jnp.zeros((1, hid))
+        c = jnp.zeros((1, hid))
+        h, c = self._jit_cell(params, jnp.asarray(xi)[None, :], h, c)
+        beams = [(0.0, [], h, c)]
+        ws = jnp.asarray(word_vectors)
+        done = []
+        for _ in range(n_steps):
+            candidates = []
+            for logp, toks, h, c in beams:
+                logprobs = np.asarray(jax.nn.log_softmax(
+                    h @ params["W"] + params["b"])[0])
+                for tok in np.argsort(-logprobs)[:beam_width]:
+                    candidates.append((logp + float(logprobs[tok]),
+                                       toks + [int(tok)], h, c))
+            candidates.sort(key=lambda b: -b[0])
+            beams = []
+            for logp, toks, h, c in candidates[:beam_width]:
+                if end_token is not None and toks[-1] == end_token:
+                    done.append((toks, logp))
+                    continue
+                h2, c2 = self._jit_cell(params, ws[toks[-1]][None, :], h, c)
+                beams.append((logp, toks, h2, c2))
+            if not beams:
+                break
+        done.extend((toks, logp) for logp, toks, _, _ in beams)
+        return sorted(done, key=lambda p: -p[1])

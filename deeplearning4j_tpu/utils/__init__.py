@@ -1,3 +1,15 @@
-"""Host-side utilities (serialization, misc math)."""
+"""Host-side utilities (reference: deeplearning4j-core util/ — ModelSerializer,
+ImageLoader, ArchiveUtils, DiskBasedQueue, StringGrid, MathUtils)."""
 
 from deeplearning4j_tpu.utils.serializer import ModelSerializer  # noqa: F401
+from deeplearning4j_tpu.utils.archive import unzip_file_to  # noqa: F401
+from deeplearning4j_tpu.utils.diskqueue import DiskBasedQueue  # noqa: F401
+from deeplearning4j_tpu.utils.stringgrid import StringGrid  # noqa: F401
+from deeplearning4j_tpu.utils.image import (  # noqa: F401
+    as_matrix,
+    as_row_vector,
+    decode_png,
+    load_image,
+    resize,
+    save_pgm,
+)

@@ -1,0 +1,263 @@
+"""ImageLSTM + utils (reference: nn/layers/recurrent/ImageLSTM.java,
+util/ImageLoader.java, ArchiveUtils.java, DiskBasedQueue.java,
+StringGrid.java, MathUtils.java)."""
+
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils import (
+    DiskBasedQueue,
+    StringGrid,
+    as_matrix,
+    as_row_vector,
+    decode_png,
+    load_image,
+    resize,
+    save_pgm,
+    unzip_file_to,
+)
+from deeplearning4j_tpu.utils import mathutils as mu
+
+
+class TestImageLSTM:
+    def _net(self):
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration,
+                                                Updater)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+            .updater(Updater.ADAM).list()
+            .layer(0, L.ImageLSTM(n_in=6, n_out=5, hidden_size=8))
+            .layer(1, L.RnnOutputLayer(n_in=5, n_out=5))
+            .set_input_type(InputType.recurrent(6))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_forward_shapes_and_training(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        net = self._net()
+        x = rng.normal(size=(4, 7, 6)).astype(np.float32)
+        y = np.zeros((4, 7, 5), np.float32)
+        y[..., 0] = 1.0
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 7, 5)
+        s0 = None
+        for _ in range(20):
+            net.fit(DataSet(x, y))
+            s0 = s0 or net.score_value
+        assert net.score_value < s0
+
+    def test_conf_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.layers import LayerConf
+
+        lc = L.ImageLSTM(n_in=6, n_out=5, hidden_size=8)
+        again = LayerConf.from_dict(lc.to_dict())
+        assert isinstance(again, L.ImageLSTM) and again.hidden_size == 8
+
+    def test_beam_search_decodes(self, rng):
+        net = self._net()
+        impl = net.layers[0]
+        params = net.params["0"]
+        xi = rng.normal(size=(6,)).astype(np.float32)
+        ws = rng.normal(size=(5, 6)).astype(np.float32)  # token → input vec
+        results = impl.beam_search(params, xi, ws, n_steps=4, beam_width=2)
+        assert results, "beam search returned nothing"
+        tokens, logp = results[0]
+        assert len(tokens) == 4
+        assert all(0 <= t < 5 for t in tokens)
+        assert logp <= 0  # log-prob
+        # scores sorted best-first
+        scores = [lp for _, lp in results]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_beam_search_end_token(self, rng):
+        net = self._net()
+        impl = net.layers[0]
+        results = impl.beam_search(
+            net.params["0"], rng.normal(size=(6,)).astype(np.float32),
+            rng.normal(size=(5, 6)).astype(np.float32),
+            n_steps=8, beam_width=3, end_token=0)
+        for tokens, _ in results:
+            if 0 in tokens:
+                assert tokens[-1] == 0 or 0 not in tokens[:-1]
+
+    def test_masking_holds_state(self, rng):
+        """Masked trailing steps must not change the final unmasked output."""
+        import jax.numpy as jnp
+
+        net = self._net()
+        impl = net.layers[0]
+        p = net.params["0"]
+        x3 = rng.normal(size=(2, 3, 6)).astype(np.float32)
+        x5 = np.concatenate(
+            [x3, rng.normal(size=(2, 2, 6)).astype(np.float32)], axis=1)
+        mask = np.array([[1, 1, 1, 0, 0]] * 2, np.float32)
+        y3, _ = impl.forward(p, jnp.asarray(x3), {})
+        y5, _ = impl.forward(p, jnp.asarray(x5), {}, mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(y3), np.asarray(y5)[:, :3],
+                                   atol=1e-6)
+
+
+class TestImageIO:
+    def test_png_roundtrip_gray(self, tmp_path):
+        from deeplearning4j_tpu.ui.listeners import encode_png_gray
+
+        img = (np.arange(48).reshape(6, 8) * 5).astype(np.uint8)
+        png = encode_png_gray(img)
+        decoded = decode_png(png)
+        np.testing.assert_array_equal(decoded, img)
+
+    def test_pgm_roundtrip_and_loaders(self, tmp_path):
+        img = (np.arange(24).reshape(4, 6) * 10).astype(np.uint8)
+        p = str(tmp_path / "img.pgm")
+        save_pgm(p, img)
+        loaded = load_image(p)
+        np.testing.assert_array_equal(loaded, img)
+        m = as_matrix(p)
+        assert m.dtype == np.float32 and m.max() <= 1.0
+        assert as_row_vector(p).shape == (24,)
+
+    def test_resize_nearest(self):
+        img = np.arange(16).reshape(4, 4)
+        small = resize(img, 2, 2)
+        assert small.shape == (2, 2)
+        assert small[0, 0] == img[0, 0]
+        big = resize(img, 8, 8)
+        assert big.shape == (8, 8)
+
+    def test_unsupported_format(self, tmp_path):
+        p = tmp_path / "x.bin"
+        p.write_bytes(b"garbage")
+        with pytest.raises(ValueError):
+            load_image(str(p))
+
+
+class TestArchive:
+    def test_unzip(self, tmp_path):
+        z = tmp_path / "a.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("inner/data.txt", "hello")
+        dest = tmp_path / "out"
+        unzip_file_to(str(z), str(dest))
+        assert (dest / "inner" / "data.txt").read_text() == "hello"
+
+    def test_tar_gz(self, tmp_path):
+        import tarfile
+
+        src = tmp_path / "f.txt"
+        src.write_text("content")
+        t = tmp_path / "a.tar.gz"
+        with tarfile.open(t, "w:gz") as tf:
+            tf.add(src, arcname="f.txt")
+        dest = tmp_path / "out2"
+        unzip_file_to(str(t), str(dest))
+        assert (dest / "f.txt").read_text() == "content"
+
+    def test_zip_slip_rejected(self, tmp_path):
+        z = tmp_path / "evil.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("../escape.txt", "bad")
+        with pytest.raises(ValueError):
+            unzip_file_to(str(z), str(tmp_path / "out3"))
+
+
+class TestDiskQueue:
+    def test_fifo_and_drain(self, tmp_path):
+        with DiskBasedQueue(str(tmp_path / "q")) as q:
+            assert q.poll() is None and q.is_empty()
+            for i in range(5):
+                q.add({"i": i})
+            assert q.size() == 5
+            assert q.poll()["i"] == 0
+            rest = [x["i"] for x in q.drain()]
+            assert rest == [1, 2, 3, 4]
+            assert q.is_empty()
+
+    def test_close_cleans_dir(self, tmp_path):
+        d = str(tmp_path / "q2")
+        q = DiskBasedQueue(d)
+        q.add(1)
+        q.close()
+        assert not os.path.exists(d)
+
+
+class TestStringGrid:
+    def _grid(self):
+        return StringGrid.from_input(
+            ["a,1,x", "b,2,", "a,1,x", "c,3,z"], sep=",")
+
+    def test_accessors(self):
+        g = self._grid()
+        assert g.num_rows() == 4 and g.num_columns() == 3
+        assert g.get_column(0) == ["a", "b", "a", "c"]
+        assert g.get_row(1) == ["b", "2", ""]
+
+    def test_transforms(self):
+        g = self._grid()
+        assert g.dedupe_rows().num_rows() == 3
+        assert g.remove_rows_with_empty_column(2).num_rows() == 3
+        assert g.filter_by_value(0, "a").num_rows() == 2
+        assert g.sort_by_column(0, reverse=True).get_column(0)[0] == "c"
+        assert g.select_columns([2, 0]).get_row(0) == ["x", "a"]
+        g2 = g.append_column(["p", "q", "r", "s"])
+        assert g2.num_columns() == 4
+        with pytest.raises(ValueError):
+            g.append_column(["only-one"])
+
+    def test_file_roundtrip(self, tmp_path):
+        g = self._grid()
+        p = str(tmp_path / "grid.csv")
+        g.write_file(p)
+        assert StringGrid.from_file(p, ",") == g
+
+    def test_ragged_rejected(self):
+        with pytest.raises(ValueError):
+            StringGrid(",", [["a"], ["b", "c"]])
+
+
+class TestMathUtils:
+    def test_normalize_and_clamp(self):
+        assert mu.normalize(5, 0, 10) == 0.5
+        with pytest.raises(ValueError):
+            mu.normalize(1, 2, 2)
+        assert mu.clamp(11, 0, 10) == 10
+        out = mu.normalize_array([1, 2, 3], 0, 1)
+        np.testing.assert_allclose(out, [0, 0.5, 1])
+
+    def test_entropy_and_gain(self):
+        assert mu.entropy([0.5, 0.5]) == pytest.approx(1.0)
+        assert mu.entropy([1.0]) == 0.0
+        gain = mu.information_gain([8, 8], [[8, 0], [0, 8]])
+        assert gain == pytest.approx(1.0)  # perfect split
+
+    def test_regression_stats(self):
+        a = [1.0, 2.0, 3.0]
+        assert mu.ss_error(a, a) == 0.0
+        assert mu.correlation([1, 2, 3], [2, 4, 6]) == pytest.approx(1.0)
+        assert mu.correlation([1, 2, 3], [3, 2, 1]) == pytest.approx(-1.0)
+        assert mu.correlation([1, 1, 1], [1, 2, 3]) == 0.0
+        assert mu.sum_of_products([1, 2], [3, 4]) == 11.0
+
+    def test_discretize_and_powers(self):
+        assert mu.discretize(0.0, 0, 1, 4) == 0
+        assert mu.discretize(1.0, 0, 1, 4) == 3
+        assert mu.next_power_of_2(5) == 8
+        assert mu.next_power_of_2(1) == 1
+        assert mu.round_to_decimals(1.23456, 2) == 1.23
+
+    def test_misc(self):
+        assert mu.sigmoid(0.0) == 0.5
+        assert mu.sigmoid(-700) == pytest.approx(0.0, abs=1e-300)
+        assert mu.bernoullis(1, 2, 0.5) == pytest.approx(0.5)
+        assert mu.combination(5, 2) == 10
+        w = mu.weights_for([10, 1])
+        assert w.sum() == pytest.approx(1.0) and w[1] > w[0]

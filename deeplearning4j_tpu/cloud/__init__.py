@@ -1,0 +1,7 @@
+"""Cloud provisioning (reference: deeplearning4j-aws — EC2/S3 → TPU VM/GCS)."""
+
+from deeplearning4j_tpu.cloud.provision import (  # noqa: F401
+    GcsTransfer,
+    TpuProvisioner,
+    TpuVmSpec,
+)

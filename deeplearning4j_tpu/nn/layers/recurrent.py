@@ -254,41 +254,17 @@ class ImageLSTMImpl(LayerImpl):
 
     def forward(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
-        policy = get_policy()
-        act = self.activation_fn()
-        b, t, _ = x.shape
         n_in = self.conf.n_in
-        hid = self._hidden()
-        # hoist the input half of the combined RW GEMM over all timesteps
-        # (one [b·t, n_in] @ [n_in, 4h] MXU matmul), as _lstm_scan does;
-        # only the recurrent half runs per scan step
-        RW_in = policy.cast_compute(params["RW"][:n_in])
-        RW_rec = policy.cast_compute(params["RW"][n_in:])
-        xW = policy.cast_compute(x).reshape(b * t, n_in) @ RW_in
-        xW = policy.cast_output(xW).reshape(b, t, 4 * hid) + params["gb"]
-        h0 = state.get("h")
-        c0 = state.get("c")
-        h = jnp.zeros((b, hid), xW.dtype) if h0 is None else h0
-        c = jnp.zeros((b, hid), xW.dtype) if c0 is None else c0
-        if mask is None:
-            mask_t = jnp.ones((t, b, 1), xW.dtype)
-        else:
-            mask_t = jnp.swapaxes(mask.astype(xW.dtype), 0, 1)[..., None]
-
-        def step(carry, inp):
-            h_prev, c_prev = carry
-            z_t, m = inp
-            z = z_t + policy.cast_output(
-                policy.cast_compute(h_prev) @ RW_rec)
-            h_new, c_new = self._gates(z, c_prev, act)
-            h_new = m * h_new + (1.0 - m) * h_prev
-            c_new = m * c_new + (1.0 - m) * c_prev
-            return (h_new, c_new), h_new
-
-        (hT, cT), hs = lax.scan(step, (h, c),
-                                (jnp.swapaxes(xW, 0, 1), mask_t))
-        ys = jnp.swapaxes(hs, 0, 1) @ params["W"] + params["b"]
-        ys = ys * jnp.swapaxes(mask_t, 0, 1)  # masked steps output zero
+        # the combined RW param splits into _lstm_scan's input/recurrent
+        # halves — one shared implementation of the hoisted-GEMM recurrence
+        view = {"W": params["RW"][:n_in], "RW": params["RW"][n_in:],
+                "b": params["gb"]}
+        hs, (hT, cT) = _lstm_scan(view, x, self.activation_fn(),
+                                  peepholes=False, mask=mask,
+                                  h0=state.get("h"), c0=state.get("c"))
+        ys = hs @ params["W"] + params["b"]
+        if mask is not None:  # zero padded steps after the bias add
+            ys = ys * mask.astype(ys.dtype)[..., None]
         new_state = dict(state)
         if "h" in state:
             new_state["h"] = hT

@@ -1,0 +1,124 @@
+"""Cluster configuration registry.
+
+Re-design of ``deeplearning4j-scaleout-zookeeper`` (725 LoC:
+ZooKeeperConfigurationRegister/Retriever, ZookeeperBuilder, PathBuilder):
+the reference serializes a Canova ``Configuration`` into a ZooKeeper znode
+path ``/<host>/<task>`` so cluster members can fetch their runtime config.
+On a TPU pod the equivalent shared medium is the filesystem every worker
+already mounts (GCS fuse / NFS / local for tests), so this registry stores
+JSON configs under a root directory with atomic publish (tempfile +
+``os.replace``), mtime-based watches, and the same register/retrieve
+surface. No quorum service needed: JAX's single-controller model means the
+registry is written by the launcher and read by workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+class ConfigRegistry:
+    """register/retrieve/list/watch named JSON configs
+    (ZooKeeperConfigurationRegister.java / ZooKeeperConfigurationRetriever)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, host: str, task: str) -> str:
+        # the reference's znode path scheme: /<host>/<task>; names are
+        # validated so no value can escape the registry root
+        for name in (host, task):
+            if not name or not _NAME_RE.match(name) or name in (".", ".."):
+                raise ValueError(
+                    f"invalid registry name {name!r}: use letters, digits, "
+                    f"'.', '_', '-'")
+        return os.path.join(self.root, host, task + ".json")
+
+    # -- write ----------------------------------------------------------
+    def register(self, host: str, task: str,
+                 config: Dict[str, Any]) -> None:
+        path = self._path(host, task)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(config, f)
+            os.replace(tmp, path)  # readers never see partial JSON
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def unregister(self, host: str, task: str) -> None:
+        try:
+            os.unlink(self._path(host, task))
+        except FileNotFoundError:
+            pass
+
+    # -- read -----------------------------------------------------------
+    def retrieve(self, host: str, task: str) -> Dict[str, Any]:
+        try:
+            with open(self._path(host, task)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            raise KeyError(f"no config registered for {host}/{task}")
+
+    def exists(self, host: str, task: str) -> bool:
+        return os.path.exists(self._path(host, task))
+
+    def tasks(self, host: str) -> List[str]:
+        d = os.path.join(self.root, host)
+        if not os.path.isdir(d):
+            return []
+        return sorted(p[:-5] for p in os.listdir(d) if p.endswith(".json"))
+
+    def hosts(self) -> List[str]:
+        return sorted(h for h in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, h)))
+
+    # -- watch ----------------------------------------------------------
+    def wait_for(self, host: str, task: str, timeout_s: float = 30.0,
+                 poll_s: float = 0.1) -> Dict[str, Any]:
+        """Block until a config appears (the worker-side retrieve-with-retry
+        the reference does against ZooKeeper)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self.exists(host, task):
+                return self.retrieve(host, task)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"config {host}/{task} not registered "
+                                   f"within {timeout_s}s")
+            time.sleep(poll_s)
+
+    def watch(self, host: str, task: str,
+              callback: Callable[[Optional[Dict[str, Any]]], None],
+              timeout_s: float = 30.0,
+              poll_s: float = 0.1) -> None:
+        """Invoke ``callback`` on the next change (mtime watch). Deletion is
+        a change too: the callback receives ``None`` when the config was
+        unregistered."""
+        path = self._path(host, task)
+        try:
+            last = os.path.getmtime(path)
+        except FileNotFoundError:
+            last = None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                mtime = os.path.getmtime(path)
+            except FileNotFoundError:
+                mtime = None
+            if mtime != last:
+                callback(self.retrieve(host, task)
+                         if mtime is not None else None)
+                return
+            time.sleep(poll_s)
+        raise TimeoutError(f"no change on {host}/{task} within {timeout_s}s")

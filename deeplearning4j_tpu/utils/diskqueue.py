@@ -27,13 +27,17 @@ class DiskBasedQueue:
         return os.path.join(self._dir, f"{i:012d}.pkl")
 
     def add(self, item: Any) -> None:
+        if item is None:
+            raise ValueError("None cannot be queued (poll's empty sentinel)")
+        # serialize outside the lock; claim the index AND publish the file
+        # under it, so poll can never reserve an index whose file is missing
+        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(item, f, protocol=pickle.HIGHEST_PROTOCOL)
         with self._lock:
             idx = self._tail
+            os.replace(tmp, self._path(idx))
             self._tail += 1
-        tmp = self._path(idx) + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(item, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, self._path(idx))  # publish atomically
 
     def poll(self) -> Optional[Any]:
         """Pop the oldest item; None when empty (Queue.poll semantics)."""

@@ -133,11 +133,15 @@ def _decode_pnm(data: bytes) -> np.ndarray:
             pos += 1
         parts.append(int(data[start:pos]))
     pos += 1  # single whitespace after maxval
-    width, height, _maxval = parts
+    width, height, maxval = parts
+    if not 0 < maxval <= 255:
+        raise ValueError(f"only 8-bit PNM supported (maxval {maxval})")
     channels = 3 if data[:2] == b"P6" else 1
     pixels = np.frombuffer(data, np.uint8, count=width * height * channels,
                            offset=pos)
     img = pixels.reshape(height, width, channels)
+    if maxval != 255:  # rescale so as_matrix's /255 is correct
+        img = (img.astype(np.uint16) * 255 // maxval).astype(np.uint8)
     return img[:, :, 0] if channels == 1 else img
 
 

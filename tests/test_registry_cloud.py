@@ -1,0 +1,126 @@
+"""Config registry + TPU provisioning (reference:
+deeplearning4j-scaleout-zookeeper ZooKeeperConfigurationRegister/Retriever;
+deeplearning4j-aws Ec2BoxCreator/HostProvisioner/S3Uploader)."""
+
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.cloud import GcsTransfer, TpuProvisioner, TpuVmSpec
+from deeplearning4j_tpu.parallel.registry import ConfigRegistry
+
+
+class TestConfigRegistry:
+    def test_register_retrieve_roundtrip(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        conf = {"lr": 0.1, "layers": [4, 8, 3], "algo": "sgd"}
+        reg.register("host-a", "train", conf)
+        assert reg.retrieve("host-a", "train") == conf
+        assert reg.exists("host-a", "train")
+        assert reg.tasks("host-a") == ["train"]
+        assert reg.hosts() == ["host-a"]
+
+    def test_missing_raises_keyerror(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        with pytest.raises(KeyError):
+            reg.retrieve("nope", "train")
+        assert reg.tasks("nope") == []
+
+    def test_unregister(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        reg.register("h", "t", {"a": 1})
+        reg.unregister("h", "t")
+        assert not reg.exists("h", "t")
+        reg.unregister("h", "t")  # idempotent
+
+    def test_overwrite_updates(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        reg.register("h", "t", {"v": 1})
+        reg.register("h", "t", {"v": 2})
+        assert reg.retrieve("h", "t")["v"] == 2
+
+    def test_wait_for_blocks_until_registered(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+
+        def later():
+            time.sleep(0.1)
+            reg.register("h", "t", {"ready": True})
+
+        t = threading.Thread(target=later)
+        t.start()
+        got = reg.wait_for("h", "t", timeout_s=5.0)
+        t.join()
+        assert got == {"ready": True}
+
+    def test_wait_for_times_out(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        with pytest.raises(TimeoutError):
+            reg.wait_for("h", "never", timeout_s=0.2)
+
+    def test_watch_sees_change(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        reg.register("h", "t", {"v": 1})
+        seen = []
+
+        def later():
+            time.sleep(0.15)
+            reg.register("h", "t", {"v": 2})
+
+        t = threading.Thread(target=later)
+        t.start()
+        reg.watch("h", "t", seen.append, timeout_s=5.0)
+        t.join()
+        assert seen == [{"v": 2}]
+
+
+class TestTpuProvisioner:
+    def _prov(self, **kw):
+        spec = TpuVmSpec(name="trainer-0", zone="us-central2-b",
+                        accelerator_type="v5litepod-8",
+                        project="my-proj", **kw)
+        return TpuProvisioner(spec, dry_run=True)
+
+    def test_create_command(self):
+        cmd = self._prov(preemptible=True, tags=["dl4j", "exp"]
+                         ).create_command()
+        s = " ".join(cmd)
+        assert s.startswith("gcloud compute tpus tpu-vm create trainer-0")
+        assert "--zone=us-central2-b" in cmd
+        assert "--project=my-proj" in cmd
+        assert "--accelerator-type=v5litepod-8" in cmd
+        assert "--preemptible" in cmd
+        assert "--tags=dl4j,exp" in cmd
+
+    def test_delete_ssh_scp_commands(self):
+        p = self._prov()
+        assert "--quiet" in p.delete_command()
+        ssh = p.run_command("echo hi", worker="0")
+        assert "--worker=0" in ssh and "--command=echo hi" in ssh
+        scp = p.copy_command("/tmp/x", "~/x")
+        assert "trainer-0:~/x" in scp and "--worker=all" in scp
+
+    def test_bootstrap_sequence_and_script(self):
+        p = self._prov()
+        p.bootstrap("/tmp/repo", extra_setup=["sudo ldconfig"])
+        assert len(p.commands_issued) == 4  # scp, install, setup, sanity
+        script = p.script()
+        assert "gcloud" in script and "device_count" in script
+        # dry run: nothing executed, everything recorded
+        assert all(c[0] == "gcloud" for c in p.commands_issued)
+
+
+class TestGcsTransfer:
+    def test_commands(self):
+        t = GcsTransfer(dry_run=True)
+        t.upload("/data/mnist", "gs://bucket/mnist")
+        t.download("gs://bucket/model", "/tmp/model")
+        assert t.commands_issued[0][:3] == ["gsutil", "-m", "cp"]
+        assert t.commands_issued[1][-2] == "gs://bucket/model"
+
+    def test_bad_uri_rejected(self):
+        t = GcsTransfer()
+        with pytest.raises(ValueError):
+            t.upload("/x", "s3://nope")
+        with pytest.raises(ValueError):
+            t.download("http://nope", "/x")

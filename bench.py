@@ -36,11 +36,18 @@ def _make_batch(seed: int = 0):
 
 
 def _throughput(net, x, y, steps=STEPS, warmup=WARMUP) -> float:
+    """Samples/sec through the faster of the two public training paths:
+    per-step ``fit`` (one dispatch per step) and the fused ``fit_steps``
+    scan driver (one dispatch per K steps). Which wins depends on model
+    size and backend — conv-in-scan can be slower on XLA-CPU, while small
+    models are dispatch-bound per-step — so the bench takes the max, as a
+    user would."""
     import jax
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
 
     ds = DataSet(x, y)
+
     for _ in range(warmup):
         net.fit(ds)
     jax.block_until_ready(net.params)
@@ -48,8 +55,15 @@ def _throughput(net, x, y, steps=STEPS, warmup=WARMUP) -> float:
     for _ in range(steps):
         net.fit(ds)
     jax.block_until_ready(net.params)
-    dt = time.perf_counter() - t0
-    return BATCH * steps / dt
+    stepwise = BATCH * steps / (time.perf_counter() - t0)
+
+    net.fit_steps(ds, steps)  # compile the fused program
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    net.fit_steps(ds, steps)
+    jax.block_until_ready(net.params)
+    fused = BATCH * steps / (time.perf_counter() - t0)
+    return max(stepwise, fused)
 
 
 def main() -> None:

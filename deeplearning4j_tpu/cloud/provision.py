@@ -135,8 +135,9 @@ class TpuProvisioner:
 
 class GcsTransfer:
     """Dataset up/download (s3/reader/S3Downloader.java,
-    s3/uploader/S3Uploader.java) via gsutil; local-filesystem fallback keeps
-    tests hermetic."""
+    s3/uploader/S3Uploader.java) via gsutil commands; ``dry_run`` records
+    the commands without executing, keeping tests hermetic. gs:// URIs
+    only."""
 
     def __init__(self, dry_run: bool = True):
         self.dry_run = dry_run

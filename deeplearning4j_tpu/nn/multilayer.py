@@ -183,40 +183,65 @@ class MultiLayerNetwork:
     # the jitted train step (replaces Solver/StochasticGradientDescent +
     # BaseUpdater for the SGD family)
     # ------------------------------------------------------------------
+    def _step_impl(self, params, updater_state, net_state, iteration,
+                   lr_scale_host, x, y, feature_mask, label_mask, rng,
+                   rnn_state):
+        gc = self.conf.global_conf
+        with dtypes_mod.policy_scope(self._policy):
+            def loss_fn(p):
+                return self._loss_and_state(
+                    p, net_state, x, y, feature_mask, label_mask, rng,
+                    train=True, rnn_state=rnn_state,
+                )
+
+            (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            scale = lr_policy_scale(
+                gc.lr_policy, iteration, gc.lr_policy_decay_rate,
+                gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
+                base_lr=gc.learning_rate,
+            ) * lr_scale_host
+            new_params, new_updater = {}, {}
+            for i, spec in enumerate(self.updater_specs):
+                si = str(i)
+                steps_i, upd_i = apply_updater(
+                    spec, grads[si], updater_state[si], scale, iteration + 1
+                )
+                new_params[si] = jax.tree_util.tree_map(
+                    lambda p, s: p - s.astype(p.dtype), params[si], steps_i
+                )
+                new_updater[si] = upd_i
+        return new_params, new_updater, new_net_state, new_rnn, loss
+
     @functools.cached_property
     def _train_step(self):
-        gc = self.conf.global_conf
+        return jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
 
-        def step(params, updater_state, net_state, iteration, lr_scale_host,
-                 x, y, feature_mask, label_mask, rng, rnn_state):
-            with dtypes_mod.policy_scope(self._policy):
-                def loss_fn(p):
-                    return self._loss_and_state(
-                        p, net_state, x, y, feature_mask, label_mask, rng,
-                        train=True, rnn_state=rnn_state,
-                    )
+    @functools.cached_property
+    def _multi_train_step(self):
+        """K SGD steps fused into ONE XLA program via ``lax.scan`` — the
+        batch transfers once and there is a single host dispatch per K
+        steps, eliminating per-step launch overhead for small models (the
+        equivalent of the reference's `iterations(n)` inner loop, but
+        compiled)."""
 
-                (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params)
-                scale = lr_policy_scale(
-                    gc.lr_policy, iteration, gc.lr_policy_decay_rate,
-                    gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
-                    base_lr=gc.learning_rate,
-                ) * lr_scale_host
-                new_params, new_updater = {}, {}
-                for i, spec in enumerate(self.updater_specs):
-                    si = str(i)
-                    steps_i, upd_i = apply_updater(
-                        spec, grads[si], updater_state[si], scale, iteration + 1
-                    )
-                    new_params[si] = jax.tree_util.tree_map(
-                        lambda p, s: p - s.astype(p.dtype), params[si], steps_i
-                    )
-                    new_updater[si] = upd_i
-            return new_params, new_updater, new_net_state, new_rnn, loss
+        def multi(params, updater_state, net_state, iteration0,
+                  lr_scale_host, x, y, feature_mask, label_mask, rngs,
+                  rnn_state):
+            def body(carry, rng):
+                params, upd, nst, rnn, it = carry
+                p2, u2, s2, rnn2, loss = self._step_impl(
+                    params, upd, nst, it, lr_scale_host, x, y,
+                    feature_mask, label_mask, rng, rnn)
+                return (p2, u2, s2, rnn2, it + 1), loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+            carry0 = (params, updater_state, net_state, rnn_state,
+                      iteration0)
+            (p, u, s, rnn, _), losses = jax.lax.scan(body, carry0, rngs)
+            return p, u, s, rnn, losses[-1]
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _score_fn(self):
@@ -280,6 +305,45 @@ class MultiLayerNetwork:
                 for _ in range(max(1, gc.iterations)):
                     self._sgd_step(ds)
                     self._post_iteration()
+
+    def fit_steps(self, ds, n_steps: int):
+        """``fit(ds)`` called ``n_steps`` times, fused: the batch transfers
+        once and all ``n_steps · conf.iterations`` SGD iterations run as ONE
+        XLA program (see ``_multi_train_step``). Listeners fire once, after
+        the fused block, with the final score. Falls back to a plain ``fit``
+        loop for non-SGD optimizers, TBPTT, pretraining, and the
+        score-reactive LR policy (which needs a host decision per step)."""
+        self._ensure_init()
+        gc = self.conf.global_conf
+        if not self.conf.backprop and not self.conf.pretrain:
+            return self  # fit() trains nothing in this configuration
+        if (gc.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+                or (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                    and _is_temporal(ds.features))
+                or self.conf.pretrain
+                or gc.lr_policy == LearningRatePolicy.SCORE):
+            for _ in range(n_steps):
+                self.fit(ds)
+            return self
+        total = n_steps * max(1, gc.iterations)
+        keys = jax.random.split(self._rng, total + 1)
+        self._rng = keys[0]
+        (self.params, self.updater_state, self.net_state, _, loss) = (
+            self._multi_train_step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                jnp.asarray(self._lr_scale_host, jnp.float32),
+                _dev(ds.features), _dev(ds.labels),
+                _dev(ds.features_mask), _dev(ds.labels_mask),
+                keys[1:], None,
+            )
+        )
+        self._score = loss
+        self._last_input = ds.features
+        self.iteration_count += total
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+        return self
 
     def _sgd_step(self, ds, rnn_state=None):
         self._rng, rng = jax.random.split(self._rng)

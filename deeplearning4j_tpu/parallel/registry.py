@@ -17,11 +17,12 @@ from __future__ import annotations
 import json
 import os
 import re
-import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+from deeplearning4j_tpu.utils.fileio import atomic_write_text
+
+_NAME_RE = re.compile(r"\A[A-Za-z0-9._-]+\Z")
 
 
 class ConfigRegistry:
@@ -47,15 +48,7 @@ class ConfigRegistry:
                  config: Dict[str, Any]) -> None:
         path = self._path(host, task)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(config, f)
-            os.replace(tmp, path)  # readers never see partial JSON
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        atomic_write_text(path, json.dumps(config))
 
     def unregister(self, host: str, task: str) -> None:
         try:
@@ -91,8 +84,10 @@ class ConfigRegistry:
         the reference does against ZooKeeper)."""
         deadline = time.monotonic() + timeout_s
         while True:
-            if self.exists(host, task):
+            try:
                 return self.retrieve(host, task)
+            except KeyError:  # not registered yet (or unregistered between
+                pass          # the check and the read) — keep waiting
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"config {host}/{task} not registered "
                                    f"within {timeout_s}s")
@@ -117,8 +112,12 @@ class ConfigRegistry:
             except FileNotFoundError:
                 mtime = None
             if mtime != last:
-                callback(self.retrieve(host, task)
-                         if mtime is not None else None)
+                try:
+                    payload = (self.retrieve(host, task)
+                               if mtime is not None else None)
+                except KeyError:  # deleted between stat and read
+                    payload = None
+                callback(payload)
                 return
             time.sleep(poll_s)
         raise TimeoutError(f"no change on {host}/{task} within {timeout_s}s")

@@ -24,12 +24,13 @@ from __future__ import annotations
 import fcntl
 import json
 import os
-import tempfile
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.utils.fileio import atomic_write_text
 
 
 @dataclass
@@ -200,15 +201,7 @@ class FileStateTracker(StateTracker):
     def _atomic_write(self, path: str, data: str) -> None:
         # staged in a separate tmp/ dir so directory listings of jobs/ and
         # beats/ never see half-written entries
-        fd, tmp = tempfile.mkstemp(dir=os.path.join(self.root, "tmp"))
-        try:
-            with os.fdopen(fd, "w") as f:
-                f.write(data)
-            os.replace(tmp, path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        atomic_write_text(path, data, tmp_dir=os.path.join(self.root, "tmp"))
 
     def _job_path(self, jid: str) -> str:
         return os.path.join(self.root, "jobs", jid + ".json")

@@ -1,0 +1,24 @@
+"""Atomic file publication shared by the coordination modules (statetracker,
+config registry): write to a tempfile on the same filesystem, then
+``os.replace`` — readers never observe partial content."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+
+def atomic_write_text(path: str, data: str,
+                      tmp_dir: Optional[str] = None) -> None:
+    """Write ``data`` to ``path`` atomically. ``tmp_dir`` (default: the
+    target's directory) must be on the same filesystem as ``path``."""
+    fd, tmp = tempfile.mkstemp(dir=tmp_dir or os.path.dirname(path))
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

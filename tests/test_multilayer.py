@@ -187,3 +187,70 @@ class TestDropoutAndRegularization:
         initial = net.score(ds)
         net.fit(ListDataSetIterator(ds, batch_size=64), num_epochs=15)
         assert net.score(ds) < initial
+
+
+class TestFitSteps:
+    """Fused multi-step driver (fit_steps) must match the per-step fit path."""
+
+    def _net(self, seed=0):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+            .updater(Updater.ADAM).list()
+            .layer(0, L.DenseLayer(n_in=6, n_out=12, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=12, n_out=3))
+            .build()
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork(conf).init()
+
+    def test_matches_stepwise_fit(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        ds = DataSet(x, y)
+        a, b = self._net(), self._net()
+        for _ in range(5):
+            a.fit(ds)
+        b.fit_steps(ds, 5)
+        assert b.iteration_count == 5
+        np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                                   rtol=1e-5, atol=1e-6)
+        assert abs(a.score_value - b.score_value) < 1e-5
+
+    def test_listener_fires_once(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresIterationListener)
+
+        x = rng.normal(size=(8, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net = self._net()
+        lst = CollectScoresIterationListener()
+        net.set_listeners(lst)
+        net.fit_steps(DataSet(x, y), 7)
+        assert [it for it, _ in lst.scores] == [7]
+
+    def test_lbfgs_falls_back(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+            .optimization_algo(OptimizationAlgorithm.LBFGS).list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=2))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        net.fit_steps(DataSet(x, y), 2)  # falls back to fit loop
+        assert np.isfinite(net.score_value)

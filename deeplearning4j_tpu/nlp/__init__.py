@@ -22,6 +22,10 @@ from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
 )
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec_iterator import (  # noqa: F401
+    Word2VecDataSetIterator,
+)
 from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
 from deeplearning4j_tpu.nlp.trees import Tree, build_word_index  # noqa: F401
 from deeplearning4j_tpu.nlp.viterbi import Viterbi  # noqa: F401

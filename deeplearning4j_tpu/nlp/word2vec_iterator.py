@@ -1,0 +1,94 @@
+"""Word2VecDataSetIterator — labeled text → DataSets of window embeddings.
+
+Re-design of ``models/word2vec/iterator/Word2VecDataSetIterator.java``
+(291 LoC): the reference slides a moving window over each labeled sentence,
+concatenates the word vectors of the window into one feature row, one-hot
+encodes the sentence's label for every window, and batches the rows into
+``DataSet``s for a downstream classifier. Same semantics here; the vector
+lookup is one embedding gather per batch (``syn0[indices]``) instead of
+per-word fetches.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.nlp.movingwindow import window_indices
+
+
+class Word2VecDataSetIterator(DataSetIterator):
+    """Iterate DataSets whose rows are flattened word-vector windows.
+
+    ``vectors``: a fitted Word2Vec/SequenceVectors (needs ``vocab`` +
+    ``syn0``); ``labeled_sentences``: (tokens, label) pairs; ``labels``:
+    the label universe (order fixes one-hot columns).
+    """
+
+    def __init__(self, vectors, labeled_sentences: Sequence[Tuple[Sequence[str], str]],
+                 labels: Sequence[str], window_size: int = 5,
+                 batch: int = 32):
+        if vectors.vocab is None or vectors.syn0 is None:
+            raise ValueError("vectors must be fitted (vocab + syn0)")
+        self.vectors = vectors
+        self.window_size = window_size
+        self.batch = batch
+        self.labels = list(labels)
+        label_index = {l: i for i, l in enumerate(self.labels)}
+        syn0 = np.asarray(vectors.syn0)
+        self._dim = syn0.shape[1]
+        # row 0 stands in for padding/unknown — zero it so <s>/unk windows
+        # contribute nothing rather than an arbitrary word's vector
+        self._table = np.concatenate(
+            [np.zeros((1, self._dim), syn0.dtype), syn0])
+        shifted = {w: vectors.vocab.index_of(w) + 1
+                   for w in vectors.vocab.words()}
+
+        # only int32 window-index rows + label ids are materialized; the
+        # [batch, w·d] float features are gathered lazily in next()
+        idx_rows: List[np.ndarray] = []
+        ys: List[int] = []
+        for tokens, label in labeled_sentences:
+            if label not in label_index:
+                raise ValueError(f"unknown label {label!r}")
+            toks = list(tokens)
+            if not toks:
+                continue
+            idx = window_indices(toks, shifted, window_size, unk_index=0)
+            idx_rows.append(idx)
+            ys.extend([label_index[label]] * idx.shape[0])
+        self._indices = (np.concatenate(idx_rows) if idx_rows
+                         else np.zeros((0, window_size), np.int32))
+        self._label_ids = np.asarray(ys, np.int64)
+        self._pos = 0
+
+    # -- DataSetIterator surface ---------------------------------------
+    def has_next(self) -> bool:
+        return self._pos < len(self._indices)
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num or self.batch
+        idx = self._indices[self._pos:self._pos + n]
+        ys = self._label_ids[self._pos:self._pos + n]
+        self._pos += n
+        feats = self._table[idx].reshape(len(idx), -1).astype(np.float32)
+        labels = np.eye(len(self.labels), dtype=np.float32)[ys]
+        return DataSet(feats, labels)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch_size(self) -> int:
+        return self.batch
+
+    def total_examples(self) -> int:
+        return len(self._indices)
+
+    def input_columns(self) -> int:
+        return self.window_size * self._dim
+
+    def total_outcomes(self) -> int:
+        return len(self.labels)

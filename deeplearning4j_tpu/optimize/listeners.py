@@ -89,6 +89,70 @@ class TimeIterationListener(IterationListener):
         return (self.count - self.warmup) / (time.perf_counter() - self.start_time)
 
 
+class ProfilerIterationListener(IterationListener):
+    """JAX device profiler around a window of training iterations.
+
+    The reference had no in-tree profiler (SURVEY §5 — closest is
+    ParamAndGradientIterationListener); the TPU-native equivalent is an
+    XPlane trace via ``jax.profiler`` viewable in TensorBoard/XProf. The
+    trace starts after iteration ``start_iteration`` completes and stops
+    after the first iteration ≥ ``end_iteration`` (so iterations
+    (start, end] are captured). Call ``close()`` — or rely on the
+    finalizer — if training may end mid-window: XPlane data is only
+    flushed on stop. Degrades to a no-op if the profiler backend is
+    unavailable.
+    """
+
+    def __init__(self, log_dir: str, start_iteration: int = 2,
+                 end_iteration: int = 5):
+        if end_iteration <= start_iteration:
+            raise ValueError("end_iteration must exceed start_iteration")
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.end_iteration = end_iteration
+        self.active = False
+        self.failed = False
+
+    def iteration_done(self, model, iteration):
+        import jax
+
+        if self.failed:
+            return
+        try:
+            # >= comparisons: fused drivers (fit_steps) may jump the
+            # iteration count past either boundary in one firing
+            if (not self.active
+                    and self.start_iteration <= iteration < self.end_iteration):
+                jax.profiler.start_trace(self.log_dir)
+                self.active = True
+            elif self.active and iteration >= self.end_iteration:
+                jax.block_until_ready(model.params)
+                jax.profiler.stop_trace()
+                self.active = False
+        except Exception as e:  # profiler backend unavailable: disable
+            log.warning("profiler listener disabled: %s", e)
+            self.failed = True
+            self.active = False
+
+    def close(self) -> None:
+        """Stop and flush a still-open trace (training ended mid-window)."""
+        if not self.active:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("profiler stop failed: %s", e)
+        self.active = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class CollectScoresIterationListener(IterationListener):
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, frequency)

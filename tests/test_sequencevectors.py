@@ -1,0 +1,171 @@
+"""SequenceVectors engine, Word2VecDataSetIterator, profiler listener
+(reference: models/sequencevectors/SequenceVectors.java,
+models/word2vec/iterator/Word2VecDataSetIterator.java)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import SequenceVectors, Word2VecDataSetIterator
+
+
+def _walk_corpus():
+    """Vertex-sequence corpus with two clusters: {a,b,c} and {x,y,z}."""
+    rng = np.random.default_rng(0)
+    groups = [["a", "b", "c"], ["x", "y", "z"]]
+    seqs = []
+    for _ in range(120):
+        g = groups[rng.integers(0, 2)]
+        seqs.append([g[i] for i in rng.integers(0, 3, 8)])
+    return seqs
+
+
+class TestSequenceVectors:
+    def test_builder_and_cluster_structure(self):
+        seqs = _walk_corpus()
+        vec = (SequenceVectors.Builder()
+               .iterate(seqs).layer_size(16).window_size(3)
+               .negative_sample(4).epochs(8).seed(1)
+               .min_element_frequency(1).build())
+        vec.fit()
+        assert vec.get_element_vector("a").shape == (16,)
+        # co-occurring elements end up closer than cross-cluster ones
+        for other in ("x", "y", "z"):
+            assert vec.similarity("a", "b") > vec.similarity("a", other)
+        assert vec.elements_nearest("a", top_n=1)[0] in {"b", "c"}
+
+    def test_builder_requires_sequences(self):
+        with pytest.raises(ValueError):
+            SequenceVectors.Builder().build()
+
+    def test_hs_mode(self):
+        seqs = _walk_corpus()[:40]
+        vec = (SequenceVectors.Builder().iterate(seqs).layer_size(8)
+               .use_hierarchic_softmax(True).epochs(2).build())
+        vec.fit()
+        assert vec.get_element_vector("x") is not None
+
+    def test_one_shot_generator_materialized(self):
+        """A generator corpus must survive fit()'s two passes (vocab then
+        pair emission)."""
+        def gen():
+            for _ in range(20):
+                yield ["a", "b", "a", "b", "a"]
+
+        vec = (SequenceVectors.Builder().iterate(gen()).layer_size(4)
+               .epochs(1).build())
+        vec.fit()
+        # training actually ran: syn1neg moved off its zero init
+        assert float(np.abs(np.asarray(vec.syn1neg)).sum()) > 0
+
+    def test_non_string_elements_coerced(self):
+        seqs = [[1, 2, 3, 1, 2], [2, 3, 1, 3, 2]] * 10
+        vec = (SequenceVectors.Builder().iterate(seqs).layer_size(4)
+               .epochs(1).build())
+        vec.fit()
+        assert vec.get_element_vector("1") is not None
+
+
+class TestWord2VecDataSetIterator:
+    def _vectors(self):
+        seqs = _walk_corpus()
+        return (SequenceVectors.Builder().iterate(seqs).layer_size(8)
+                .window_size(3).epochs(1).build()).fit()
+
+    def test_shapes_and_labels(self):
+        vec = self._vectors()
+        data = [(["a", "b", "c"], "pos"), (["x", "y"], "neg")]
+        it = Word2VecDataSetIterator(vec, data, labels=["pos", "neg"],
+                                     window_size=3, batch=4)
+        assert it.total_examples() == 5  # 3 + 2 windows
+        assert it.input_columns() == 3 * 8
+        ds = it.next()
+        assert ds.features.shape == (4, 24)
+        assert ds.labels.shape == (4, 2)
+        np.testing.assert_array_equal(ds.labels[0], [1, 0])
+        # second batch is the remainder, then exhausted; reset restarts
+        assert it.next().features.shape[0] == 1
+        assert not it.has_next()
+        it.reset()
+        assert it.has_next()
+
+    def test_padding_windows_are_zero(self):
+        vec = self._vectors()
+        it = Word2VecDataSetIterator(vec, [(["a"], "pos")], labels=["pos"],
+                                     window_size=3)
+        row = it.next(1).features[0].reshape(3, 8)
+        assert np.all(row[0] == 0)  # <s> slot
+        assert np.all(row[2] == 0)  # </s> slot
+        assert not np.all(row[1] == 0)  # the word itself
+
+    def test_unknown_label_rejected(self):
+        vec = self._vectors()
+        with pytest.raises(ValueError):
+            Word2VecDataSetIterator(vec, [(["a"], "mystery")],
+                                    labels=["pos"])
+
+    def test_unfitted_vectors_rejected(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+
+        with pytest.raises(ValueError):
+            Word2VecDataSetIterator(Word2Vec(), [], labels=["x"])
+
+    def test_trains_downstream_classifier(self, rng):
+        """End-to-end: embedding windows feed a dense classifier."""
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        vec = self._vectors()
+        data = ([ (["a","b","c","a","b"], "pos") ] * 8
+                + [ (["x","y","z","x","y"], "neg") ] * 8)
+        it = Word2VecDataSetIterator(vec, data, labels=["pos", "neg"],
+                                     window_size=3, batch=16)
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+                .updater(Updater.ADAM).list()
+                .layer(0, L.DenseLayer(n_in=it.input_columns(), n_out=16,
+                                       activation="relu"))
+                .layer(1, L.OutputLayer(n_in=16, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, num_epochs=20)
+        it.reset()
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+class TestProfilerListener:
+    def test_trace_written(self, tmp_path, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.listeners import (
+            ProfilerIterationListener)
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+                .list()
+                .layer(0, L.DenseLayer(n_in=4, n_out=8))
+                .layer(1, L.OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        log_dir = str(tmp_path / "trace")
+        lst = ProfilerIterationListener(log_dir, start_iteration=1,
+                                        end_iteration=3)
+        net.set_listeners(lst)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        for _ in range(5):
+            net.fit(DataSet(x, y))
+        assert not lst.active
+        if not lst.failed:  # backend present: trace files must exist
+            found = [f for _, _, fs in os.walk(log_dir) for f in fs]
+            assert found, "no trace output written"
+
+    def test_bad_window_rejected(self):
+        from deeplearning4j_tpu.optimize.listeners import (
+            ProfilerIterationListener)
+
+        with pytest.raises(ValueError):
+            ProfilerIterationListener("/tmp/x", 5, 5)

@@ -53,9 +53,10 @@ def _row_scale(n_rows, idx):
     return 1.0 / jnp.maximum(counts[idx], 1.0)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _neg_sampling_step(syn0, syn1neg, centers, contexts, negatives, lr):
-    """Skip-gram with negative sampling, one batch of pairs.
+def _neg_sampling_math(syn0, syn1neg, centers, contexts, negatives, lr):
+    """Skip-gram with negative sampling, one batch of pairs (pure math,
+    reused by the single-device jitted step and the mesh-sharded step in
+    ``nlp/distributed.py``).
 
     centers/contexts: [B]; negatives: [B, K]; returns updated tables + loss.
     """
@@ -85,6 +86,9 @@ def _neg_sampling_step(syn0, syn1neg, centers, contexts, negatives, lr):
     syn1neg = syn1neg.at[negatives.reshape(-1)].add(
         -((g_neg * sc_neg)[..., None] * h[:, None, :]).reshape(-1, h.shape[-1]))
     return syn0, syn1neg, loss
+
+
+_neg_sampling_step = jax.jit(_neg_sampling_math, donate_argnums=(0, 1))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -367,13 +371,19 @@ class Word2Vec:
                             jnp.ones((len(x), 1), jnp.float32),
                             jnp.asarray(c), jnp.asarray(negs), lr)
                     else:
-                        negs = self._sample_negatives(len(c), x)
-                        self.syn0, self.syn1neg, loss = _neg_sampling_step(
-                            self.syn0, self.syn1neg, jnp.asarray(c),
-                            jnp.asarray(x), jnp.asarray(negs), lr)
+                        loss = self._neg_batch(c, x, lr)
                     total_steps += 1
         self._norm_cache = None
         return self
+
+    def _neg_batch(self, c: np.ndarray, x: np.ndarray, lr: float):
+        """One NEG skip-gram batch — the seam DistributedWord2Vec overrides
+        to shard the batch over a mesh (nlp/distributed.py)."""
+        negs = self._sample_negatives(len(c), x)
+        self.syn0, self.syn1neg, loss = _neg_sampling_step(
+            self.syn0, self.syn1neg, jnp.asarray(c), jnp.asarray(x),
+            jnp.asarray(negs), lr)
+        return loss
 
     def _sample_negatives(self, b: int, positives: np.ndarray) -> np.ndarray:
         k = max(1, self.negative)

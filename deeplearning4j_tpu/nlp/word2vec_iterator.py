@@ -35,7 +35,7 @@ class Word2VecDataSetIterator(DataSetIterator):
             raise ValueError("vectors must be fitted (vocab + syn0)")
         self.vectors = vectors
         self.window_size = window_size
-        self.batch = batch
+        self._batch_size = batch
         self.labels = list(labels)
         label_index = {l: i for i, l in enumerate(self.labels)}
         syn0 = np.asarray(vectors.syn0)
@@ -70,7 +70,7 @@ class Word2VecDataSetIterator(DataSetIterator):
         return self._pos < len(self._indices)
 
     def next(self, num: Optional[int] = None) -> DataSet:
-        n = num or self.batch
+        n = num or self._batch_size
         idx = self._indices[self._pos:self._pos + n]
         ys = self._label_ids[self._pos:self._pos + n]
         self._pos += n
@@ -81,8 +81,11 @@ class Word2VecDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self._pos = 0
 
+    def batch(self) -> int:  # DataSetIterator protocol
+        return self._batch_size
+
     def batch_size(self) -> int:
-        return self.batch
+        return self._batch_size
 
     def total_examples(self) -> int:
         return len(self._indices)

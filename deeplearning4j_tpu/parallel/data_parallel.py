@@ -110,8 +110,39 @@ class ParallelWrapper:
         net._post_iteration()
 
     def output(self, x):
+        x = np.asarray(x)
+        if x.shape[0] % self.data_parallelism == 0:
+            x = self._shard_batch(x)  # else: unsharded fallback
         with self.mesh:
             return self.network.output(x)
+
+    def evaluate(self, data):
+        """Distributed evaluation: each batch's forward shards over the
+        mesh; per-batch Evaluations merge on host — the reference's
+        map-side EvaluateFlatMapFunction + Evaluation.merge reduce
+        (SparkDl4jMultiLayer.evaluate :576-607) with the map side compiled.
+        Batches whose size does not divide the mesh run unsharded."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        if isinstance(data, DataSet):
+            batches = [data]
+        else:
+            if hasattr(data, "reset"):
+                data.reset()
+            batches = data
+        total = Evaluation()
+        dp = self.data_parallelism
+        for ds in batches:
+            x = (self._shard_batch(ds.features)
+                 if ds.num_examples() % dp == 0 else ds.features)
+            with self.mesh:
+                out = np.asarray(self.network.output(x))
+            part = Evaluation()
+            part.eval(np.asarray(ds.labels), out,
+                      mask=None if ds.labels_mask is None
+                      else np.asarray(ds.labels_mask))
+            total.merge(part)
+        return total
 
 
 class ParameterAveragingTrainer:

@@ -59,3 +59,13 @@ from deeplearning4j_tpu.parallel.cluster import (  # noqa: F401
     HeartbeatMonitor,
     initialize_distributed,
 )
+from deeplearning4j_tpu.parallel.registry import ConfigRegistry  # noqa: F401
+from deeplearning4j_tpu.parallel.workrouter import (  # noqa: F401
+    DistributedTrainer,
+    HogwildWorkRouter,
+    IterativeReduceWorkRouter,
+    NetworkWorkPerformer,
+    WorkRouter,
+    WorkerPerformer,
+    average_aggregator,
+)

@@ -131,12 +131,8 @@ class ParallelWrapper:
                 data.reset()
             batches = data
         total = Evaluation()
-        dp = self.data_parallelism
         for ds in batches:
-            x = (self._shard_batch(ds.features)
-                 if ds.num_examples() % dp == 0 else ds.features)
-            with self.mesh:
-                out = np.asarray(self.network.output(x))
+            out = np.asarray(self.output(ds.features))
             part = Evaluation()
             part.eval(np.asarray(ds.labels), out,
                       mask=None if ds.labels_mask is None

@@ -96,6 +96,30 @@ class StateTracker:
     def get_meta(self, key: str, default: Any = None) -> Any:
         raise NotImplementedError
 
+    # -- worker updates (StateTracker.java workerUpdates; arrays) --------
+    def post_update(self, worker_id: str, update) -> None:
+        raise NotImplementedError
+
+    def updates(self) -> Dict[str, Any]:
+        """Non-destructive snapshot (barrier peek)."""
+        raise NotImplementedError
+
+    def drain_updates(self) -> Dict[str, Any]:
+        """Atomically take-and-remove all posted updates: an update is
+        either returned to exactly one drainer or left for the next one —
+        never silently dropped (the check-then-clear race)."""
+        raise NotImplementedError
+
+    def clear_updates(self) -> None:
+        self.drain_updates()
+
+    # -- binary array metadata (global params channel) -------------------
+    def put_array(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get_array(self, key: str, default: Any = None) -> Any:
+        raise NotImplementedError
+
 
 class InMemoryStateTracker(StateTracker):
     """Thread-safe in-process tracker (embedded-Hazelcast role)."""
@@ -106,6 +130,8 @@ class InMemoryStateTracker(StateTracker):
         self._order: List[str] = []
         self._beats: Dict[str, float] = {}
         self._meta: Dict[str, Any] = {}
+        self._updates: Dict[str, Any] = {}
+        self._arrays: Dict[str, Any] = {}
 
     def add_job(self, payload: Any, job_id: Optional[str] = None) -> str:
         with self._lock:
@@ -176,6 +202,32 @@ class InMemoryStateTracker(StateTracker):
     def get_meta(self, key: str, default: Any = None) -> Any:
         with self._lock:
             return self._meta.get(key, default)
+
+    def post_update(self, worker_id: str, update) -> None:
+        import numpy as np
+
+        with self._lock:
+            self._updates[worker_id] = np.asarray(update)
+
+    def updates(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._updates)
+
+    def drain_updates(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._updates)
+            self._updates.clear()
+            return out
+
+    def put_array(self, key: str, value) -> None:
+        import numpy as np
+
+        with self._lock:
+            self._arrays[key] = np.asarray(value)
+
+    def get_array(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._arrays.get(key, default)
 
 
 class FileStateTracker(StateTracker):
@@ -348,4 +400,80 @@ class FileStateTracker(StateTracker):
             with open(os.path.join(self.root, "meta", key + ".json")) as f:
                 return json.load(f)
         except (FileNotFoundError, json.JSONDecodeError):
+            return default
+
+    # -- worker updates (updates/ dir of .npy; staged in tmp/, published
+    # with os.replace, consumed with os.rename — every transition atomic) --
+    def _updates_dir(self) -> str:
+        d = os.path.join(self.root, "updates")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _save_array(self, target: str, value) -> None:
+        import numpy as np
+        import tempfile as _tf
+
+        fd, tmp = _tf.mkstemp(dir=os.path.join(self.root, "tmp"),
+                              suffix=".npy")
+        with os.fdopen(fd, "wb") as f:
+            np.save(f, np.asarray(value))
+        os.replace(tmp, target)
+
+    def post_update(self, worker_id: str, update) -> None:
+        self._save_array(
+            os.path.join(self._updates_dir(), worker_id + ".npy"), update)
+
+    def updates(self) -> Dict[str, Any]:
+        import numpy as np
+
+        out: Dict[str, Any] = {}
+        for name in sorted(os.listdir(self._updates_dir())):
+            if not name.endswith(".npy"):
+                continue
+            try:
+                out[name[:-4]] = np.load(
+                    os.path.join(self._updates_dir(), name))
+            except (OSError, ValueError):
+                continue  # torn read under concurrent replace: skip
+        return out
+
+    def drain_updates(self) -> Dict[str, Any]:
+        import numpy as np
+
+        out: Dict[str, Any] = {}
+        for name in sorted(os.listdir(self._updates_dir())):
+            if not name.endswith(".npy"):
+                continue
+            path = os.path.join(self._updates_dir(), name)
+            # rename-to-take: a concurrent replace either lands before (we
+            # take the new file) or after (it stays for the next drain)
+            grave = os.path.join(self.root, "tmp",
+                                 f"drain-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+            try:
+                os.rename(path, grave)
+            except FileNotFoundError:
+                continue  # another drainer took it
+            try:
+                out[name[:-4]] = np.load(grave)
+            except (OSError, ValueError):
+                pass
+            finally:
+                try:
+                    os.unlink(grave)
+                except FileNotFoundError:
+                    pass
+        return out
+
+    # -- binary array metadata --
+    def put_array(self, key: str, value) -> None:
+        d = os.path.join(self.root, "arrays")
+        os.makedirs(d, exist_ok=True)
+        self._save_array(os.path.join(d, key + ".npy"), value)
+
+    def get_array(self, key: str, default: Any = None) -> Any:
+        import numpy as np
+
+        try:
+            return np.load(os.path.join(self.root, "arrays", key + ".npy"))
+        except (OSError, ValueError):
             return default

@@ -1,0 +1,251 @@
+"""Work routing: synchronous iterative-reduce vs asynchronous Hogwild.
+
+Re-design of the reference's scaleout SPI and its two dispatch policies:
+``deeplearning4j-scaleout-api/.../workrouter/WorkRouter.java`` with
+``IterativeReduceWorkRouter.java:48-53`` (master waits until
+``updates.size() >= workers.size()`` before averaging + redistribution) and
+``HogWildWorkRouter.java:32`` ("Async updates" — apply each worker's update
+as it lands, no barrier); performers per
+``perform/BaseMultiLayerNetworkWorkPerformer.java`` (deserialize conf JSON,
+fit on the job's DataSet, emit flat params) and aggregation per
+``aggregator/INDArrayAggregator`` (parameter averaging).
+
+The actor system is gone: workers are threads or processes sharing a
+``StateTracker`` (in-memory or file-backed), the master loop is
+``DistributedTrainer`` (the ``DeepLearning4jDistributed.train()`` role,
+SURVEY §3.4), and the heavy math inside each perform() is the normal jitted
+device step. This layer exists for the reference's *control-plane* parity —
+in-slice gradient sync should use ``ParallelWrapper``'s XLA collectives
+instead (SURVEY §7.7a).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.statetracker import StateTracker
+
+
+# ---------------------------------------------------------------------------
+# SPI
+# ---------------------------------------------------------------------------
+
+
+class WorkerPerformer:
+    """perform(job payload) → flat update array (WorkerPerformer.java)."""
+
+    def perform(self, payload: Any) -> np.ndarray:
+        raise NotImplementedError
+
+    def update(self, params: np.ndarray) -> None:
+        """Receive redistributed parameters (WorkerPerformer.update)."""
+
+
+class NetworkWorkPerformer(WorkerPerformer):
+    """Fit a MultiLayerNetwork on each job's DataSet and emit flat params
+    (BaseMultiLayerNetworkWorkPerformer.java: conf JSON in, params out)."""
+
+    def __init__(self, conf_json: str, fit_epochs: int = 1):
+        from deeplearning4j_tpu.nn.conf.neural_net import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        self.network = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(conf_json)).init()
+        self.fit_epochs = fit_epochs
+
+    def perform(self, payload: Any) -> np.ndarray:
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        ds = DataSet(np.asarray(payload["features"], np.float32),
+                     np.asarray(payload["labels"], np.float32))
+        self.network.fit(ds, num_epochs=self.fit_epochs)
+        return self.network.get_flat_params()
+
+    def update(self, params: np.ndarray) -> None:
+        self.network.set_flat_params(np.asarray(params))
+
+
+def average_aggregator(updates: Sequence[np.ndarray]) -> np.ndarray:
+    """INDArrayAggregator: element-wise mean (parameter averaging)."""
+    if not updates:
+        raise ValueError("no updates to aggregate")
+    return np.mean(np.stack([np.asarray(u) for u in updates]), axis=0)
+
+
+class WorkRouter:
+    """Decides when worker updates become the new global parameters."""
+
+    def __init__(self, tracker: StateTracker,
+                 aggregator: Callable[[Sequence[np.ndarray]], np.ndarray]
+                 = average_aggregator):
+        self.tracker = tracker
+        self.aggregator = aggregator
+        self.rounds = 0
+
+    def post(self, worker_id: str, update: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def step(self, num_workers: int) -> bool:
+        """Master tick; True when global params advanced this tick."""
+        raise NotImplementedError
+
+    def current_params(self) -> Optional[np.ndarray]:
+        got = self.tracker.get_array("global_params")
+        return None if got is None else np.asarray(got, np.float32)
+
+    def _publish(self, params: np.ndarray) -> None:
+        # binary channel: flat params are MBs — never JSON-encode them
+        self.tracker.put_array("global_params", np.asarray(params))
+        self.rounds += 1
+
+
+class IterativeReduceWorkRouter(WorkRouter):
+    """Barrier semantics (IterativeReduceWorkRouter.java:48-53): aggregate
+    only once EVERY worker has posted, then redistribute. The barrier peeks
+    non-destructively; consumption is an atomic drain, so updates posted
+    between peek and drain are aggregated, never dropped."""
+
+    def post(self, worker_id: str, update: np.ndarray) -> None:
+        self.tracker.post_update(worker_id, update)
+
+    def step(self, num_workers: int) -> bool:
+        if len(self.tracker.updates()) < num_workers:
+            return False
+        updates = self.tracker.drain_updates()
+        if not updates:
+            return False
+        self._publish(self.aggregator(
+            [updates[k] for k in sorted(updates)]))
+        return True
+
+
+class HogwildWorkRouter(WorkRouter):
+    """Async semantics (HogWildWorkRouter.java:32): each update folds into
+    the global params immediately — no waiting on stragglers. The fold is
+    serialized per router instance (in-process workers); cross-process
+    Hogwild should give each process its own router over a shared tracker
+    and accept last-write races on the published params, as the reference
+    does by design."""
+
+    def __init__(self, tracker: StateTracker, mix: float = 0.5, **kw):
+        super().__init__(tracker, **kw)
+        self.mix = mix  # how far to move toward the incoming update
+        self._fold_lock = threading.Lock()
+
+    def post(self, worker_id: str, update: np.ndarray) -> None:
+        with self._fold_lock:  # read-modify-write must not drop updates
+            cur = self.current_params()
+            new = (np.asarray(update, np.float32) if cur is None
+                   else (1.0 - self.mix) * cur
+                   + self.mix * np.asarray(update, np.float32))
+            self._publish(new)
+
+    def step(self, num_workers: int) -> bool:
+        return False  # nothing gated on the master
+
+
+# ---------------------------------------------------------------------------
+# the master/worker loop (DeepLearning4jDistributed.train(), in-process)
+# ---------------------------------------------------------------------------
+
+
+class DistributedTrainer:
+    """Run jobs through N worker threads under a router's policy.
+
+    In-process stand-in for the actor runtime (MasterActor poll loop
+    :106-139 + WorkerActor pool :183-203), testable on one host the way the
+    reference's ``BaseTestDistributed`` boots an embedded actor system.
+    """
+
+    def __init__(self, tracker: StateTracker, router: WorkRouter,
+                 performer_factory: Callable[[], WorkerPerformer],
+                 num_workers: int = 2, poll_s: float = 0.01,
+                 max_attempts: int = 3):
+        self.tracker = tracker
+        self.router = router
+        self.performer_factory = performer_factory
+        self.num_workers = num_workers
+        self.poll_s = poll_s
+        self.max_attempts = max_attempts
+        self.performers: List[WorkerPerformer] = []
+        self.errors: List[str] = []
+
+    def _worker_loop(self, worker_id: str, performer: WorkerPerformer,
+                     stop: threading.Event) -> None:
+        while not stop.is_set():
+            self.tracker.heartbeat(worker_id)
+            job = self.tracker.claim_job(worker_id)
+            if job is None:
+                time.sleep(self.poll_s)
+                continue
+            try:
+                latest = self.router.current_params()
+                if latest is not None:
+                    performer.update(latest)
+                update = performer.perform(job.payload)
+                self.router.post(worker_id, update)
+                self.tracker.complete_job(job.job_id)
+            except Exception as e:
+                # a poison job must not kill the worker pool: bounded
+                # requeue, permanent failure after max_attempts, error kept
+                # for the master (JobFailed protocol)
+                import traceback
+
+                self.errors.append(
+                    f"{job.job_id} attempt {job.attempts}: "
+                    f"{traceback.format_exc()}")
+                requeue = job.attempts < self.max_attempts
+                self.tracker.fail_job(job.job_id, requeue=requeue)
+
+    def train(self, timeout_s: float = 120.0,
+              raise_on_failed_jobs: bool = True) -> np.ndarray:
+        """Drain all pending jobs; returns the final global params."""
+        stop = threading.Event()
+        self.performers = [self.performer_factory()
+                           for _ in range(self.num_workers)]
+        threads = [
+            threading.Thread(
+                target=self._worker_loop,
+                args=(f"worker-{i}", p, stop), daemon=True)
+            for i, p in enumerate(self.performers)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout_s
+        try:
+            while time.monotonic() < deadline:
+                self.router.step(self.num_workers)
+                pending = self.tracker.jobs(status="pending")
+                claimed = self.tracker.jobs(status="claimed")
+                if not pending and not claimed:
+                    break
+                time.sleep(self.poll_s)
+            else:
+                raise TimeoutError(
+                    "jobs not drained in time"
+                    + (f"; worker errors: {self.errors[-1]}"
+                       if self.errors else ""))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+        params = self.router.current_params()
+        # a final partial barrier round (fewer posts than workers) still
+        # carries finished jobs' training — fold it in, never discard
+        leftover = self.tracker.drain_updates()
+        if leftover:
+            vals = [leftover[k] for k in sorted(leftover)]
+            if params is not None:
+                vals.append(params)
+            params = self.router.aggregator(vals)
+        if raise_on_failed_jobs and self.tracker.jobs(status="failed"):
+            raise RuntimeError(
+                f"{len(self.tracker.jobs(status='failed'))} job(s) failed "
+                f"permanently; last error:\n"
+                f"{self.errors[-1] if self.errors else '(none recorded)'}")
+        return params

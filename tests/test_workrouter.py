@@ -1,0 +1,176 @@
+"""Work routers + distributed trainer (reference: workrouter/
+IterativeReduceWorkRouter.java, HogWildWorkRouter.java,
+perform/BaseMultiLayerNetworkWorkPerformer.java,
+aggregator/INDArrayAggregator; loop per DeepLearning4jDistributed)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import (
+    DistributedTrainer,
+    FileStateTracker,
+    HogwildWorkRouter,
+    InMemoryStateTracker,
+    IterativeReduceWorkRouter,
+    NetworkWorkPerformer,
+    WorkerPerformer,
+    average_aggregator,
+)
+
+
+class _ConstPerformer(WorkerPerformer):
+    """Emits a fixed vector; records redistributed params. perform() sleeps
+    so both workers overlap and the barrier sees updates from each."""
+
+    def __init__(self, value):
+        self.value = np.asarray(value, np.float32)
+        self.received = []
+
+    def perform(self, payload):
+        import time
+
+        time.sleep(0.05)
+        return self.value
+
+    def update(self, params):
+        self.received.append(np.asarray(params))
+
+
+class TestAggregator:
+    def test_mean(self):
+        out = average_aggregator([np.array([1.0, 2.0]),
+                                  np.array([3.0, 4.0])])
+        np.testing.assert_allclose(out, [2.0, 3.0])
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            average_aggregator([])
+
+
+class TestIterativeReduce:
+    def test_barrier_waits_for_all(self):
+        tr = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tr)
+        router.post("w0", np.array([2.0, 2.0]))
+        assert not router.step(num_workers=2)  # one of two posted
+        assert router.current_params() is None
+        router.post("w1", np.array([4.0, 6.0]))
+        assert router.step(num_workers=2)
+        np.testing.assert_allclose(router.current_params(), [3.0, 4.0])
+        assert tr.updates() == {}  # cleared for the next round
+
+    def test_updates_channel_file_backend(self, tmp_path):
+        tr = FileStateTracker(str(tmp_path / "t"))
+        tr.post_update("w0", np.arange(4, dtype=np.float32))
+        tr.post_update("w0", np.ones(4, np.float32))  # overwrite
+        got = tr.updates()
+        np.testing.assert_allclose(got["w0"], np.ones(4))
+        tr.clear_updates()
+        assert tr.updates() == {}
+
+
+class TestHogwild:
+    def test_async_mix(self):
+        tr = InMemoryStateTracker()
+        router = HogwildWorkRouter(tr, mix=0.5)
+        router.post("w0", np.array([4.0]))
+        np.testing.assert_allclose(router.current_params(), [4.0])
+        router.post("w1", np.array([0.0]))  # no barrier: folds immediately
+        np.testing.assert_allclose(router.current_params(), [2.0])
+        assert not router.step(num_workers=2)
+
+
+class TestDistributedTrainer:
+    def test_drains_jobs_and_averages(self):
+        tr = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tr)
+        values = iter([[1.0, 1.0], [3.0, 5.0]])
+        trainer = DistributedTrainer(
+            tr, router, lambda: _ConstPerformer(next(values)),
+            num_workers=2)
+        for i in range(4):
+            tr.add_job({"i": i})
+        params = trainer.train(timeout_s=30)
+        assert params is not None
+        np.testing.assert_allclose(params, [2.0, 3.0])
+        assert tr.jobs(status="pending") == []
+        assert len(tr.jobs(status="done")) == 4
+
+    def test_poison_job_fails_bounded_and_raises(self):
+        """A job that always raises must not kill the worker pool: bounded
+        requeue, permanent failure, surfaced error."""
+
+        class _Poison(WorkerPerformer):
+            def perform(self, payload):
+                if payload == "bad":
+                    raise RuntimeError("boom")
+                return np.array([1.0])
+
+        tr = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tr)
+        trainer = DistributedTrainer(tr, router, _Poison, num_workers=2,
+                                     max_attempts=2)
+        tr.add_job("bad")
+        tr.add_job("ok")
+        tr.add_job("ok")
+        with pytest.raises(RuntimeError, match="failed permanently"):
+            trainer.train(timeout_s=30)
+        failed = tr.jobs(status="failed")
+        assert len(failed) == 1 and failed[0].attempts == 2
+        assert len(tr.jobs(status="done")) == 2  # good jobs still ran
+        assert any("boom" in e for e in trainer.errors)
+
+    def test_partial_final_round_not_discarded(self):
+        """Leftover updates from an incomplete barrier round fold into the
+        returned params instead of being dropped."""
+        tr = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tr)
+        router._publish(np.array([0.0, 0.0]))
+        tr.post_update("w0", np.array([4.0, 8.0]))  # only 1 of 2 posted
+        trainer = DistributedTrainer(tr, router, lambda: _ConstPerformer([0]),
+                                     num_workers=2)
+        params = trainer.train(timeout_s=10)
+        np.testing.assert_allclose(params, [2.0, 4.0])  # mean(update, prev)
+
+    def test_network_performer_end_to_end(self, rng):
+        """Iterative-reduce training of a real net across 2 workers beats
+        the initial score (the reference's TestDistributed role)."""
+        from deeplearning4j_tpu.nn.conf import (NeuralNetConfiguration,
+                                                Updater)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.neural_net import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+            .updater(Updater.ADAM).list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=2))
+            .build()
+        )
+        n = 64
+        x = np.concatenate([rng.normal(-2, .5, (n // 2, 4)),
+                            rng.normal(2, .5, (n // 2, 4))]).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            np.r_[np.zeros(n // 2, int), np.ones(n // 2, int)]]
+
+        tr = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tr)
+        conf_json = conf.to_json()
+        trainer = DistributedTrainer(
+            tr, router, lambda: NetworkWorkPerformer(conf_json,
+                                                     fit_epochs=5),
+            num_workers=2)
+        for s in range(0, n, 16):
+            tr.add_job({"features": x[s:s + 16].tolist(),
+                        "labels": y[s:s + 16].tolist()})
+        params = trainer.train(timeout_s=120)
+        assert params is not None
+
+        final = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(conf_json)).init()
+        final.set_flat_params(params)
+        acc = final.evaluate(DataSet(x, y)).accuracy()
+        assert acc > 0.9, acc

@@ -201,6 +201,37 @@ class SamplingDataSetIterator(DataSetIterator):
         return int(self.dataset.labels.shape[-1])
 
 
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Wraps an iterator so labels := features
+    (ReconstructionDataSetIterator — autoencoder/RBM training targets)."""
+
+    def __init__(self, underlying: DataSetIterator):
+        self.underlying = underlying
+
+    def has_next(self):
+        return self.underlying.has_next()
+
+    def next(self, num=None):
+        ds = self.underlying.next(num)
+        return DataSet(ds.features, ds.features,
+                       ds.features_mask, ds.features_mask)
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def total_examples(self):
+        return self.underlying.total_examples()
+
+    def input_columns(self):
+        return self.underlying.input_columns()
+
+    def total_outcomes(self):
+        return self.underlying.input_columns()  # labels are the features
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch wrapper (AsyncDataSetIterator.java:44).
 

@@ -97,12 +97,23 @@ class StateTracker:
         raise NotImplementedError
 
     # -- worker updates (StateTracker.java workerUpdates; arrays) --------
+    # every post gets its own entry (worker@nonce): a worker finishing two
+    # jobs in one barrier round must contribute TWO updates, not overwrite
     def post_update(self, worker_id: str, update) -> None:
         raise NotImplementedError
 
     def updates(self) -> Dict[str, Any]:
-        """Non-destructive snapshot (barrier peek)."""
+        """Non-destructive snapshot (barrier peek) — loads the arrays."""
         raise NotImplementedError
+
+    def posted_update_keys(self) -> List[str]:
+        """Cheap peek: entry keys only, no array deserialization."""
+        raise NotImplementedError
+
+    @staticmethod
+    def update_worker(key: str) -> str:
+        """Worker id from an update-entry key (``worker@nonce``)."""
+        return key.rsplit("@", 1)[0]
 
     def drain_updates(self) -> Dict[str, Any]:
         """Atomically take-and-remove all posted updates: an update is
@@ -207,11 +218,16 @@ class InMemoryStateTracker(StateTracker):
         import numpy as np
 
         with self._lock:
-            self._updates[worker_id] = np.asarray(update)
+            self._updates[f"{worker_id}@{uuid.uuid4().hex[:8]}"] = (
+                np.asarray(update))
 
     def updates(self) -> Dict[str, Any]:
         with self._lock:
             return dict(self._updates)
+
+    def posted_update_keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._updates)
 
     def drain_updates(self) -> Dict[str, Any]:
         with self._lock:
@@ -420,22 +436,24 @@ class FileStateTracker(StateTracker):
         os.replace(tmp, target)
 
     def post_update(self, worker_id: str, update) -> None:
-        self._save_array(
-            os.path.join(self._updates_dir(), worker_id + ".npy"), update)
+        name = f"{worker_id}@{uuid.uuid4().hex[:8]}.npy"
+        self._save_array(os.path.join(self._updates_dir(), name), update)
 
     def updates(self) -> Dict[str, Any]:
         import numpy as np
 
         out: Dict[str, Any] = {}
-        for name in sorted(os.listdir(self._updates_dir())):
-            if not name.endswith(".npy"):
-                continue
+        for name in self.posted_update_keys():
             try:
-                out[name[:-4]] = np.load(
-                    os.path.join(self._updates_dir(), name))
+                out[name] = np.load(
+                    os.path.join(self._updates_dir(), name + ".npy"))
             except (OSError, ValueError):
-                continue  # torn read under concurrent replace: skip
+                continue  # drained or torn under concurrency: skip
         return out
+
+    def posted_update_keys(self) -> List[str]:
+        return sorted(n[:-4] for n in os.listdir(self._updates_dir())
+                      if n.endswith(".npy"))
 
     def drain_updates(self) -> Dict[str, Any]:
         import numpy as np

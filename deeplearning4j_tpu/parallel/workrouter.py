@@ -107,14 +107,18 @@ class WorkRouter:
 class IterativeReduceWorkRouter(WorkRouter):
     """Barrier semantics (IterativeReduceWorkRouter.java:48-53): aggregate
     only once EVERY worker has posted, then redistribute. The barrier peeks
-    non-destructively; consumption is an atomic drain, so updates posted
-    between peek and drain are aggregated, never dropped."""
+    entry KEYS only (no array reads on the poll path) and counts distinct
+    workers; consumption is an atomic drain, so updates posted between peek
+    and drain — including a second post from a fast worker — are
+    aggregated, never dropped."""
 
     def post(self, worker_id: str, update: np.ndarray) -> None:
         self.tracker.post_update(worker_id, update)
 
     def step(self, num_workers: int) -> bool:
-        if len(self.tracker.updates()) < num_workers:
+        keys = self.tracker.posted_update_keys()
+        distinct = {self.tracker.update_worker(k) for k in keys}
+        if len(distinct) < num_workers:
             return False
         updates = self.tracker.drain_updates()
         if not updates:
@@ -165,13 +169,14 @@ class DistributedTrainer:
     def __init__(self, tracker: StateTracker, router: WorkRouter,
                  performer_factory: Callable[[], WorkerPerformer],
                  num_workers: int = 2, poll_s: float = 0.01,
-                 max_attempts: int = 3):
+                 max_attempts: int = 3, join_timeout_s: float = 60.0):
         self.tracker = tracker
         self.router = router
         self.performer_factory = performer_factory
         self.num_workers = num_workers
         self.poll_s = poll_s
         self.max_attempts = max_attempts
+        self.join_timeout_s = join_timeout_s
         self.performers: List[WorkerPerformer] = []
         self.errors: List[str] = []
 
@@ -232,8 +237,18 @@ class DistributedTrainer:
                        if self.errors else ""))
         finally:
             stop.set()
+            # a worker mid-perform (e.g. first-call XLA compile) must land
+            # its post before the leftover drain below, or its finished
+            # job's training would be lost — wait generously and surface a
+            # straggler instead of silently proceeding
             for t in threads:
-                t.join(timeout=5.0)
+                t.join(timeout=self.join_timeout_s)
+            stragglers = [t.name for t in threads if t.is_alive()]
+            if stragglers:
+                self.errors.append(
+                    f"worker threads still running after "
+                    f"{self.join_timeout_s}s: {stragglers}; their updates "
+                    f"may be excluded from the returned params")
         params = self.router.current_params()
         # a final partial barrier round (fewer posts than workers) still
         # carries finished jobs' training — fold it in, never discard

@@ -1,9 +1,13 @@
-"""Visualization/dimensionality-reduction: t-SNE (exact device + Barnes-Hut).
+"""Visualization/dimensionality-reduction: t-SNE (exact device + Barnes-Hut)
+and weight-filter rendering.
 
 Reference: deeplearning4j-core ``plot/`` (SURVEY §2.3) —
-``BarnesHutTsne.java`` (796), ``Tsne.java`` (432 exact version).
+``BarnesHutTsne.java`` (796), ``Tsne.java`` (432 exact version),
+``PlotFilters.java`` (141).
 """
 
 from .tsne import Tsne, BarnesHutTsne
+from .filters import filters_grid, render_layer, render_to_png
 
-__all__ = ["Tsne", "BarnesHutTsne"]
+__all__ = ["Tsne", "BarnesHutTsne", "filters_grid", "render_layer",
+           "render_to_png"]

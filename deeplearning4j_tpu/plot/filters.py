@@ -1,0 +1,61 @@
+"""Weight-filter rendering (plot/PlotFilters.java, 141 LoC).
+
+The reference tiles a layer's weight filters into one normalized image for
+the UI's renders endpoint. Same here: take a weight array — dense [n_in,
+n_out] or conv [kh, kw, c_in, n_out] — normalize each filter to [0, 255],
+and tile into a grid; ``render_to_png`` returns PNG bytes via the UI's
+encoder so the result can be POSTed to the dashboard or written to disk.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+
+def filters_grid(weights: np.ndarray, max_filters: int = 64,
+                 pad: int = 1) -> np.ndarray:
+    """Tile per-output-unit filters into a uint8 grid image."""
+    w = np.asarray(weights, np.float64)
+    if w.ndim == 2:  # dense: each column is a filter; square-ish reshape
+        n_in, n_out = w.shape
+        side = int(math.ceil(math.sqrt(n_in)))
+        padded = np.zeros((side * side, n_out))
+        padded[:n_in] = w
+        filters = padded.T.reshape(n_out, side, side)
+    elif w.ndim == 4:  # conv [kh, kw, c_in, n_out]: mean over input channels
+        filters = w.mean(axis=2).transpose(2, 0, 1)
+    else:
+        raise ValueError(f"expected rank-2 or rank-4 weights, got {w.shape}")
+    filters = filters[:max_filters]
+    n, h, wdt = filters.shape
+    cols = int(math.ceil(math.sqrt(n)))
+    rows = int(math.ceil(n / cols))
+    grid = np.zeros((rows * (h + pad) - pad, cols * (wdt + pad) - pad),
+                    np.uint8)
+    for i, f in enumerate(filters):
+        lo, hi = f.min(), f.max()
+        img = ((f - lo) / (hi - lo) * 255 if hi > lo
+               else np.zeros_like(f)).astype(np.uint8)
+        r, c = divmod(i, cols)
+        grid[r * (h + pad): r * (h + pad) + h,
+             c * (wdt + pad): c * (wdt + pad) + wdt] = img
+    return grid
+
+
+def render_to_png(weights: np.ndarray, max_filters: int = 64) -> bytes:
+    from deeplearning4j_tpu.ui.listeners import encode_png_gray
+
+    return encode_png_gray(filters_grid(weights, max_filters))
+
+
+def render_layer(model, layer_index: int,
+                 param: Optional[str] = None) -> bytes:
+    """Render a network layer's weight filters (the RendersResource role)."""
+    table = model.get_param_table()
+    key = f"{layer_index}_{param or 'W'}"
+    if key not in table:
+        raise KeyError(f"no param {key!r}; available: {sorted(table)}")
+    return render_to_png(np.asarray(table[key]))

@@ -261,3 +261,58 @@ class TestMathUtils:
         assert mu.combination(5, 2) == 10
         w = mu.weights_for([10, 1])
         assert w.sum() == pytest.approx(1.0) and w[1] > w[0]
+
+
+class TestPlotFilters:
+    def test_dense_and_conv_grids(self, rng):
+        from deeplearning4j_tpu.plot import filters_grid, render_to_png
+
+        dense = rng.normal(size=(9, 6))
+        g = filters_grid(dense)
+        assert g.dtype == np.uint8 and g.ndim == 2
+        conv = rng.normal(size=(5, 5, 3, 8))
+        g2 = filters_grid(conv)
+        # 8 filters → 3x3 grid of 5px tiles with 1px padding
+        assert g2.shape == (3 * 6 - 1, 3 * 6 - 1)
+        png = render_to_png(conv)
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        with pytest.raises(ValueError):
+            filters_grid(rng.normal(size=(3,)))
+
+    def test_render_layer(self, rng):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.plot import render_layer
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+                .list()
+                .layer(0, L.DenseLayer(n_in=16, n_out=4))
+                .layer(1, L.OutputLayer(n_in=4, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        png = render_layer(net, 0)
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        with pytest.raises(KeyError):
+            render_layer(net, 9)
+
+
+class TestReconstructionIterator:
+    def test_labels_become_features(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import (
+            ListDataSetIterator, ReconstructionDataSetIterator)
+
+        x = rng.normal(size=(20, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 20)]
+        it = ReconstructionDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8))
+        ds = it.next()
+        np.testing.assert_array_equal(ds.features, ds.labels)
+        assert it.total_outcomes() == 5
+        n = ds.num_examples()
+        while it.has_next():
+            n += it.next().num_examples()
+        assert n == 20
+        it.reset()
+        assert it.has_next()

@@ -62,11 +62,15 @@ class TestIterativeReduce:
     def test_updates_channel_file_backend(self, tmp_path):
         tr = FileStateTracker(str(tmp_path / "t"))
         tr.post_update("w0", np.arange(4, dtype=np.float32))
-        tr.post_update("w0", np.ones(4, np.float32))  # overwrite
-        got = tr.updates()
-        np.testing.assert_allclose(got["w0"], np.ones(4))
-        tr.clear_updates()
-        assert tr.updates() == {}
+        tr.post_update("w0", np.ones(4, np.float32))
+        # every post is its own entry: a fast worker's second update in one
+        # round must not overwrite its first
+        keys = tr.posted_update_keys()
+        assert len(keys) == 2
+        assert all(tr.update_worker(k) == "w0" for k in keys)
+        got = tr.drain_updates()
+        assert len(got) == 2
+        assert tr.updates() == {} and tr.posted_update_keys() == []
 
 
 class TestHogwild:

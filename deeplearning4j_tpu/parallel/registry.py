@@ -101,20 +101,26 @@ class ConfigRegistry:
         a change too: the callback receives ``None`` when the config was
         unregistered."""
         path = self._path(host, task)
-        try:
-            last = os.path.getmtime(path)
-        except FileNotFoundError:
-            last = None
+
+        def _sig():
+            # mtime alone misses same-tick rewrites on coarse-granularity
+            # shared media (GCS-fuse/NFS): fold in size. (Not st_ino —
+            # gcsfuse inodes are synthetic and churn on cache eviction,
+            # which would fire spurious change callbacks.)
+            try:
+                st = os.stat(path)
+            except FileNotFoundError:
+                return None
+            return (st.st_mtime_ns, st.st_size)
+
+        last = _sig()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            try:
-                mtime = os.path.getmtime(path)
-            except FileNotFoundError:
-                mtime = None
-            if mtime != last:
+            sig = _sig()
+            if sig != last:
                 try:
                     payload = (self.retrieve(host, task)
-                               if mtime is not None else None)
+                               if sig is not None else None)
                 except KeyError:  # deleted between stat and read
                     payload = None
                 callback(payload)

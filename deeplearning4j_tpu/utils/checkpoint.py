@@ -65,12 +65,24 @@ def restore_checkpoint(directory: str, target: Any = None,
             raise FileNotFoundError(f"no checkpoints under {directory}")
         if target is None:
             return mgr.restore(step)
-        abstract = jax.tree_util.tree_map(
-            lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
-            else jax.ShapeDtypeStruct(
-                getattr(x, "shape", ()), getattr(x, "dtype", None),
-                sharding=getattr(x, "sharding", None)),
-            target)
+        import numpy as np
+
+        def _abstract(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            dtype = getattr(x, "dtype", None)
+            if dtype is not None:
+                # jax/np arrays: shape/dtype without touching the data —
+                # sharded leaves may span non-addressable devices.
+                return jax.ShapeDtypeStruct(
+                    x.shape, dtype, sharding=getattr(x, "sharding", None))
+            # scalar python leaves (int/float) lack a dtype; np.asarray
+            # supplies one. Bare dtype=None made StandardRestore
+            # unconditionally fail on them.
+            arr = np.asarray(x)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+        abstract = jax.tree_util.tree_map(_abstract, target)
         return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     finally:
         mgr.close()

@@ -335,3 +335,28 @@ class TestExpertParallel:
         for _ in range(30):
             params, loss = step(params)
         assert float(loss) < float(loss0)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference_attention(self, causal):
+        from deeplearning4j_tpu.parallel.ulysses import ulysses_attention
+
+        rng = np.random.default_rng(0)
+        b, t, h, d = 2, 32, 8, 16  # heads divisible by sequence degree
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        mesh = build_mesh(MeshSpec(data=1, sequence=8))
+        ref = dot_product_attention(q, k, v, causal=causal)
+        uly = ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(uly),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_head_divisibility_enforced(self):
+        from deeplearning4j_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshSpec(data=1, sequence=8))
+        q = jnp.zeros((1, 16, 6, 8), jnp.float32)  # 6 heads, 8 devices
+        with pytest.raises(ValueError, match="not divisible"):
+            ulysses_attention(q, q, q, mesh)

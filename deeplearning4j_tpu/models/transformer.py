@@ -31,6 +31,8 @@ from deeplearning4j_tpu.parallel.mesh import (
     SEQUENCE_AXIS,
 )
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+from deeplearning4j_tpu.pallas.flash_attention import (
+    flash_attention, flash_default_interpret)
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -43,8 +45,12 @@ class TransformerLM:
     def __init__(self, vocab_size: int, d_model: int = 256, num_heads: int = 8,
                  num_layers: int = 4, d_ff: Optional[int] = None,
                  max_len: int = 512, lr: float = 3e-4, seed: int = 0,
-                 dtype_policy: str = "float32"):
+                 dtype_policy: str = "float32", attn_impl: str = "auto"):
         assert d_model % num_heads == 0
+        # "auto": Pallas flash kernel when a TPU backend is attached and
+        # head_dim maps onto lane tiles; "xla" / "flash" force a path
+        assert attn_impl in ("auto", "xla", "flash")
+        self.attn_impl = attn_impl
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.num_heads = num_heads
@@ -95,6 +101,18 @@ class TransformerLM:
         return self
 
     # ------------------------------------------------------------------
+    def _attn_impl(self, t: Optional[int] = None) -> str:
+        """Resolve "auto": the Pallas kernel pays off on a real TPU at
+        long sequence length (measured v5e crossover ~4k); short sequences
+        and interpret-mode backends stay on the XLA-fused path."""
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        seq = t if t is not None else self.max_len
+        if (not flash_default_interpret()
+                and seq >= 4096 and self.d_model // self.num_heads >= 64):
+            return "flash"
+        return "xla"
+
     def forward(self, params, tokens, *, mesh: Optional[Mesh] = None,
                 sequence_parallel: bool = False):
         """tokens: [b, t] int32 → logits [b, t, V]."""
@@ -112,7 +130,10 @@ class TransformerLM:
             v = (x @ policy.cast_compute(blk["attn"]["wv"])).reshape(
                 b, t, self.num_heads, -1)
             if sequence_parallel and mesh is not None:
-                o = ring_attention(q, k, v, mesh, causal=True)
+                o = ring_attention(q, k, v, mesh, causal=True,
+                                   impl=self._attn_impl(t))
+            elif self._attn_impl(t) == "flash":
+                o = flash_attention(q, k, v, causal=True)
             else:
                 o = dot_product_attention(q, k, v, causal=True)
             h = h + o.reshape(b, t, -1) @ policy.cast_compute(blk["attn"]["wo"])

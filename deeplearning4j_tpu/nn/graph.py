@@ -52,6 +52,28 @@ from deeplearning4j_tpu.nn.updater import (
 from deeplearning4j_tpu.ops.losses import compute_loss
 
 
+def _slice_mds_time(mds: MultiDataSet, start: int, end: int) -> MultiDataSet:
+    """Slice every temporal ([b, t, ...]) array to the [start, end) window;
+    non-temporal arrays pass through whole."""
+
+    def cut(a):
+        return a if a is None or np.ndim(a) < 2 else (
+            a[:, start:end] if np.ndim(a) >= 3 else a)
+
+    def cut_mask(m):
+        # masks are [b, t]
+        return None if m is None else m[:, start:end]
+
+    return MultiDataSet(
+        [cut(f) for f in mds.features],
+        [cut(l) for l in mds.labels],
+        None if mds.features_masks is None
+        else [cut_mask(m) for m in mds.features_masks],
+        None if mds.labels_masks is None
+        else [cut_mask(m) for m in mds.labels_masks],
+    )
+
+
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -66,6 +88,7 @@ class ComputationGraph:
         self._initialized = False
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
+        self._rnn_state: Dict[str, Any] = {}  # rnnTimeStep carries
 
     @property
     def score_value(self) -> float:
@@ -107,7 +130,11 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward(self, params, net_state, inputs: Sequence[jnp.ndarray], *,
                  train: bool, rng, feature_masks: Optional[Sequence] = None,
-                 collect: bool = False):
+                 collect: bool = False, rnn_state: Optional[dict] = None):
+        """``rnn_state``: {layer_name: {"h": ..., "c": ...}} initial carries
+        for recurrent layers (TBPTT windows / rnnTimeStep —
+        ComputationGraph.java:489-534,1285). When given, the matching new
+        carries are returned alongside the outputs."""
         conf = self.conf
         values: Dict[str, jnp.ndarray] = {}
         masks: Dict[str, Optional[jnp.ndarray]] = {}
@@ -115,6 +142,8 @@ class ComputationGraph:
             values[name] = inputs[i]
             masks[name] = None if feature_masks is None else feature_masks[i]
         new_net_state: Dict[str, Any] = {}
+        new_rnn_state: Optional[Dict[str, Any]] = (
+            {} if rnn_state is not None else None)
         for name in conf.topological_order:
             if name in conf.inputs:
                 continue
@@ -137,11 +166,20 @@ class ComputationGraph:
                 if rng is not None:
                     rng, sub_rng = jax.random.split(rng)
                 mask = in_mask if h.ndim == 3 else None
-                h, lstate = impl.forward(
-                    params[name], h, dict(net_state.get(name, {})),
+                lstate = dict(net_state.get(name, {}))
+                if rnn_state is not None and name in rnn_state:
+                    lstate.update(rnn_state[name])
+                h, lstate_out = impl.forward(
+                    params[name], h, lstate,
                     train=train, rng=sub_rng, mask=mask)
+                if rnn_state is not None and name in rnn_state:
+                    new_rnn_state[name] = {
+                        k: lstate_out[k] for k in rnn_state[name]
+                    }
+                    lstate_out = {k: v for k, v in lstate_out.items()
+                                  if k not in rnn_state[name]}
                 new_net_state[name] = {
-                    k: v for k, v in lstate.items()
+                    k: v for k, v in lstate_out.items()
                     if k in net_state.get(name, {})
                 }
                 values[name] = h
@@ -151,8 +189,9 @@ class ComputationGraph:
                     conf.vertices[name], in_vals, in_names, values, masks)
                 masks[name] = in_mask
         if collect:
-            return values, new_net_state
-        return [values[o] for o in conf.outputs], new_net_state
+            return values, new_net_state, new_rnn_state
+        return ([values[o] for o in conf.outputs], new_net_state,
+                new_rnn_state)
 
     def _apply_vertex(self, vertex: GraphVertexConf, in_vals, in_names,
                       values, masks):
@@ -211,10 +250,11 @@ class ComputationGraph:
     # loss over all output heads
     # ------------------------------------------------------------------
     def _loss_and_state(self, params, net_state, inputs, labels,
-                        feature_masks, label_masks, rng, train: bool):
-        outs, new_state = self._forward(
+                        feature_masks, label_masks, rng, train: bool,
+                        rnn_state=None):
+        outs, new_state, new_rnn = self._forward(
             params, net_state, inputs, train=train, rng=rng,
-            feature_masks=feature_masks)
+            feature_masks=feature_masks, rnn_state=rnn_state)
         total = 0.0
         for i, out_name in enumerate(self.conf.outputs):
             lc = self.conf.layers.get(out_name)
@@ -224,7 +264,7 @@ class ComputationGraph:
             total = total + compute_loss(lc.loss_function, outs[i], labels[i], lm)
         for name, impl in self.layer_impls.items():
             total = total + impl.l1_l2_penalty(params[name])
-        return total, new_state
+        return total, (new_state, new_rnn)
 
     # ------------------------------------------------------------------
     @functools.cached_property
@@ -232,14 +272,14 @@ class ComputationGraph:
         gc = self.conf.global_conf
 
         def step(params, updater_state, net_state, iteration, inputs, labels,
-                 feature_masks, label_masks, rng):
+                 feature_masks, label_masks, rng, rnn_state):
             with dtypes_mod.policy_scope(self._policy):
                 def loss_fn(p):
                     return self._loss_and_state(
                         p, net_state, inputs, labels, feature_masks,
-                        label_masks, rng, train=True)
+                        label_masks, rng, train=True, rnn_state=rnn_state)
 
-                (loss, new_net_state), grads = jax.value_and_grad(
+                (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
                 scale = lr_policy_scale(
                     gc.lr_policy, iteration, gc.lr_policy_decay_rate,
@@ -253,7 +293,7 @@ class ComputationGraph:
                     new_params[name] = jax.tree_util.tree_map(
                         lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
                     new_updater[name] = upd_i
-            return new_params, new_updater, new_net_state, loss
+            return new_params, new_updater, new_net_state, loss, new_rnn
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -261,8 +301,8 @@ class ComputationGraph:
     def _output_fn(self):
         def out(params, net_state, inputs):
             with dtypes_mod.policy_scope(self._policy):
-                outs, _ = self._forward(params, net_state, inputs,
-                                        train=False, rng=None)
+                outs, _, _ = self._forward(params, net_state, inputs,
+                                           train=False, rng=None)
             return outs
 
         return jax.jit(out)
@@ -287,27 +327,69 @@ class ComputationGraph:
         return self
 
     def _fit_batches(self, batches):
+        from deeplearning4j_tpu.nn.conf.enums import BackpropType
+
         gc = self.conf.global_conf
         for mds in batches:
             if isinstance(mds, DataSet):
                 mds = MultiDataSet.from_dataset(mds)
+            if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                    and any(np.ndim(f) == 3 for f in mds.features)):
+                self._fit_tbptt(mds)
+                continue
             for _ in range(max(1, gc.iterations)):
-                self._rng, rng = jax.random.split(self._rng)
-                inputs = tuple(jnp.asarray(f) for f in mds.features)
-                labels = tuple(jnp.asarray(l) for l in mds.labels)
-                fms = (None if mds.features_masks is None else tuple(
-                    None if m is None else jnp.asarray(m) for m in mds.features_masks))
-                lms = (None if mds.labels_masks is None else tuple(
-                    None if m is None else jnp.asarray(m) for m in mds.labels_masks))
-                (self.params, self.updater_state, self.net_state, loss) = (
-                    self._train_step(
-                        self.params, self.updater_state, self.net_state,
-                        jnp.asarray(self.iteration_count, jnp.int32),
-                        inputs, labels, fms, lms, rng))
-                self._score = loss  # device scalar; no per-step sync
-                self.iteration_count += 1
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration_count)
+                self._one_iteration(mds, rnn_state=None)
+
+    def _one_iteration(self, mds: MultiDataSet, rnn_state):
+        """One optimizer step; returns the new rnn carry (or None)."""
+        self._rng, rng = jax.random.split(self._rng)
+        inputs = tuple(jnp.asarray(f) for f in mds.features)
+        labels = tuple(jnp.asarray(l) for l in mds.labels)
+        fms = (None if mds.features_masks is None else tuple(
+            None if m is None else jnp.asarray(m) for m in mds.features_masks))
+        lms = (None if mds.labels_masks is None else tuple(
+            None if m is None else jnp.asarray(m) for m in mds.labels_masks))
+        (self.params, self.updater_state, self.net_state, loss,
+         new_rnn) = self._train_step(
+            self.params, self.updater_state, self.net_state,
+            jnp.asarray(self.iteration_count, jnp.int32),
+            inputs, labels, fms, lms, rng, rnn_state)
+        self._score = loss  # device scalar; no per-step sync
+        self.iteration_count += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+        return new_rnn
+
+    # ------------------------------------------------------------------
+    # truncated BPTT over the DAG (ComputationGraph.java:489-534
+    # doTruncatedBPTT; window slicing + carried stop-gradient state)
+    # ------------------------------------------------------------------
+    def _fit_tbptt(self, mds: MultiDataSet):
+        gc = self.conf.global_conf
+        t = max(f.shape[1] for f in mds.features if np.ndim(f) == 3)
+        window = self.conf.tbptt_fwd_length
+        batch = mds.num_examples()
+        rnn_state = self._zero_rnn_state(batch)
+        for start in range(0, t, window):
+            end = min(start + window, t)
+            sub = _slice_mds_time(mds, start, end)
+            for _ in range(max(1, gc.iterations)):
+                new_rnn = self._one_iteration(sub, rnn_state)
+            if new_rnn is not None:
+                # stop-gradient across window boundaries (truncation)
+                rnn_state = jax.tree_util.tree_map(
+                    jax.lax.stop_gradient, new_rnn)
+
+    def _zero_rnn_state(self, batch: int) -> Optional[Dict[str, Any]]:
+        state: Dict[str, Any] = {}
+        for name, lc in self.conf.layers.items():
+            if isinstance(lc, (L.GravesLSTM, L.LSTM)):
+                n = lc.n_out
+                state[name] = {"h": jnp.zeros((batch, n)),
+                               "c": jnp.zeros((batch, n))}
+            elif isinstance(lc, L.GRU):
+                state[name] = {"h": jnp.zeros((batch, lc.n_out))}
+        return state or None
 
     # ------------------------------------------------------------------
     def output(self, *inputs) -> List[jnp.ndarray]:
@@ -318,11 +400,38 @@ class ComputationGraph:
     def feed_forward(self, *inputs) -> Dict[str, jnp.ndarray]:
         self._ensure_init()
         with dtypes_mod.policy_scope(self._policy):
-            values, _ = self._forward(
+            values, _, _ = self._forward(
                 self.params, self.net_state,
                 tuple(jnp.asarray(x) for x in inputs),
                 train=False, rng=None, collect=True)
         return values
+
+    # ------------------------------------------------------------------
+    # rnnTimeStep (ComputationGraph.java:1285) — stateful stepping
+    # ------------------------------------------------------------------
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    def rnn_time_step(self, *inputs) -> List[jnp.ndarray]:
+        """Stateful forward for generation: hidden state carries across
+        calls. Inputs may be [b, t, f] or [b, f] (single step); 2D inputs
+        get 2D outputs back (reference parity)."""
+        self._ensure_init()
+        xs = [jnp.asarray(x) for x in inputs]
+        single_step = all(x.ndim == 2 for x in xs)
+        if single_step:
+            xs = [x[:, None, :] for x in xs]
+        if not getattr(self, "_rnn_state", None):
+            self._rnn_state = self._zero_rnn_state(xs[0].shape[0]) or {}
+        with dtypes_mod.policy_scope(self._policy):
+            outs, _, new_rnn = self._forward(
+                self.params, self.net_state, tuple(xs), train=False,
+                rng=None, rnn_state=self._rnn_state)
+        if new_rnn:
+            self._rnn_state = new_rnn
+        if single_step:
+            outs = [o[:, 0, :] if o.ndim == 3 else o for o in outs]
+        return outs
 
     def score(self, mds) -> float:
         self._ensure_init()

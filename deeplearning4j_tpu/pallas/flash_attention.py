@@ -1,0 +1,318 @@
+"""Flash attention: blockwise online-softmax Pallas kernel for TPU.
+
+Memory-optimal attention (Dao et al. flash attention recast for the TPU
+memory hierarchy): the [t, t] score matrix never leaves VMEM — the kernel
+streams K/V blocks through the MXU while carrying a running max and
+normalizer per query row, so HBM traffic is O(t·d) instead of O(t²).
+Greenfield relative to the reference (pre-transformer codebase — SURVEY §5
+"no attention of any kind"); the native-kernel analogue is the role
+libnd4j's hand-tuned ops played (deeplearning4j-core/pom.xml:154-158).
+
+Three entry points:
+
+- ``flash_attention_fwd(q, k, v, ...) -> (out, lse)`` — the raw kernel
+  launch (no autodiff). ``lse`` (log-sum-exp per query row) is what makes
+  blockwise composition possible: two attention outputs over disjoint key
+  sets merge exactly via ``logaddexp`` — ring attention uses this.
+- ``flash_attention(q, k, v, ...)`` — differentiable ``custom_vjp``
+  wrapper. The backward pass is the standard flash recomputation: given
+  the forward's ``lse`` and ``delta = Σ o·do``, each K/V block's gradient
+  contribution is independent, so it runs as a ``lax.scan`` over key
+  blocks with O(t·block) live memory and XLA fusing the blockwise math.
+- ``flash_default_interpret()`` — True when the backend has no Mosaic
+  compiler (CPU tests run the same kernel through the Pallas interpreter).
+
+Layout is BTHD ([batch, time, heads, head_dim]) to match
+``ops.attention.dot_product_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -1e30
+_LANES = 128  # running max/normalizer replicated across one lane tile
+
+
+def flash_default_interpret() -> bool:
+    """Interpret the kernel when no TPU backend is attached (CPU tests)."""
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, n_k, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        # native-dtype matmul (bf16 feeds the MXU at full rate) with f32
+        # accumulation via preferred_element_type
+        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len  # kv padding
+        if causal:
+            mask &= q_pos >= k_pos
+        s = jnp.where(mask, s, MASK_VALUE)
+
+        m_prev = m_ref[...]                              # [block_q, LANES]
+        m_cur = jnp.max(s, axis=1, keepdims=True)        # [block_q, 1]
+        m_next = jnp.maximum(m_prev, m_cur)              # broadcast
+        p = jnp.exp(s - m_next[:, :1])
+        # zero fully-masked entries: when every score in the row is masked
+        # m == MASK_VALUE and exp(s - m) would be 1, not 0
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_next)                  # [block_q, LANES]
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr[:, :1] + pv
+        m_ref[...] = m_next
+
+    if causal:
+        # skip key blocks strictly above the diagonal
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[...]                         # [block_q, LANES] replicated
+        safe_l = jnp.where(l == 0.0, 1.0, l)   # fully-masked query rows
+        o_ref[0] = (acc_ref[...] / safe_l[:, :1]).astype(o_ref.dtype)
+        # lse replicated across the lane dim (TPU block tiling needs a
+        # 128-wide last axis; the wrapper slices lane 0)
+        lse_ref[0] = m_ref[...] + jnp.log(safe_l)
+
+
+def _pad_time(x, block):
+    """Zero-pad axis 1 (time) up to a multiple of ``block``."""
+    pad = (-x.shape[1]) % block
+    if pad:
+        widths = [(0, 0)] * x.ndim
+        widths[1] = (0, pad)
+        x = jnp.pad(x, widths)
+    return x
+
+
+def flash_attention_fwd(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Kernel launch. q: [b, tq, h, d]; k/v: [b, tkv, h, d].
+
+    Returns ``(out [b, tq, h, d], lse [b, h, tq])`` with no autodiff rule —
+    use :func:`flash_attention` for training. ``causal`` assumes q and k
+    index the same absolute positions (self-attention). Default blocks are
+    the measured v5e sweet spot (t=8192: 2× the XLA-fused path); both are
+    clamped to the (128-padded) sequence length for short inputs.
+    """
+    if interpret is None:
+        interpret = flash_default_interpret()
+    b, tq, h, d = q.shape
+    tkv = k.shape[1]
+    block_q = min(block_q, -(-tq // 128) * 128)
+    block_k = min(block_k, -(-tkv // 128) * 128)
+    scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
+
+    # [b, t, h, d] -> [b*h, t, d]
+    def _flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf = _pad_time(_flat(q), block_q)
+    kf = _pad_time(_flat(k), block_k)
+    vf = _pad_time(_flat(v), block_k)
+    tq_p, tkv_p = qf.shape[1], kf.shape[1]
+    n_q, n_k = tq_p // block_q, tkv_p // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale_val, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k, kv_len=tkv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq_p, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            # bh/q blocks are independent; only the k scan carries state
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :tq].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    lse = lse[:, :tq, 0].reshape(b, h, tq)
+    return out, lse
+
+
+def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
+                   scale: Optional[float] = None, block_k: int = 1024,
+                   q_offset=0, k_offset=0):
+    """Chunked flash backward. Given the merged ``lse`` each key block's
+    gradient contribution is independent, so this scans key blocks with
+    O(t·block) live memory. Works for any sub-span of a larger attention
+    (ring backward): ``q_offset``/``k_offset`` are the absolute positions
+    of q[0] / k[0] (may be traced), ``lse``/``delta`` must come from the
+    FULL merged attention.
+
+    q/out/do: [b, tq, h, d]; k/v: [b, tkv, h, d]; lse: [b, h, tq].
+    Returns (dq, dk, dv) in the input layouts (float32).
+    """
+    b, tq, h, d = q.shape
+    tkv = k.shape[1]
+    block_k = min(block_k, -(-tkv // 128) * 128)
+    scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(out.astype(jnp.float32) * dof, axis=-1)  # [b, tq, h]
+    delta = delta.transpose(0, 2, 1)                         # [b, h, tq]
+
+    pad = (-tkv) % block_k
+    kp = _pad_time(k.astype(jnp.float32), block_k)
+    vp = _pad_time(v.astype(jnp.float32), block_k)
+    n_blocks = kp.shape[1] // block_k
+    # [n_blocks, b, block_k, h, d]
+    kb = kp.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+
+    q_pos = q_offset + jnp.arange(tq)
+
+    def step(dq, blk):
+        j, kj, vj = blk
+        k_pos = k_offset + j * block_k + jnp.arange(block_k)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj) * scale_val
+        valid = (k_pos < k_offset + tkv)[None, :]
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        s = jnp.where(valid[None, None], s, MASK_VALUE)
+        p = jnp.exp(s - lse[..., None])          # [b, h, tq, block_k]
+        p = jnp.where(valid[None, None], p, 0.0)
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vj)
+        ds = p * (dp - delta[..., None]) * scale_val
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kj)
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    dq, (dkb, dvb) = lax.scan(step, dq0,
+                              (jnp.arange(n_blocks), kb, vb))
+    dk = dkb.transpose(1, 0, 2, 3, 4).reshape(b, -1, h, d)[:, :tkv]
+    dv = dvb.transpose(1, 0, 2, 3, 4).reshape(b, -1, h, d)[:, :tkv]
+    return dq, dk, dv
+
+
+class _FlashConfig:
+    """Hashable static config for the custom_vjp nondiff argument."""
+
+    __slots__ = ("causal", "scale", "block_q", "block_k", "interpret")
+
+    def __init__(self, causal, scale, block_q, block_k, interpret):
+        self.causal = causal
+        self.scale = scale
+        self.block_q = block_q
+        self.block_k = block_k
+        self.interpret = interpret
+
+    def _key(self):
+        return (self.causal, self.scale, self.block_q, self.block_k,
+                self.interpret)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return (isinstance(other, _FlashConfig)
+                and self._key() == other._key())
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _FlashConfig, q, k, v):
+    out, _ = flash_attention_fwd(
+        q, k, v, causal=cfg.causal, scale=cfg.scale, block_q=cfg.block_q,
+        block_k=cfg.block_k, interpret=cfg.interpret)
+    return out
+
+
+def _flash_fwd_rule(cfg, q, k, v):
+    out, lse = flash_attention_fwd(
+        q, k, v, causal=cfg.causal, scale=cfg.scale, block_q=cfg.block_q,
+        block_k=cfg.block_k, interpret=cfg.interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(cfg, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = flash_backward(
+        q, k, v, out, lse, do, causal=cfg.causal, scale=cfg.scale,
+        block_k=cfg.block_k)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Differentiable flash attention. q: [b, tq, h, d] → [b, tq, h, d].
+
+    Drop-in for ``ops.attention.dot_product_attention(q, k, v, causal=...)``
+    when there is no padding mask / additive bias (callers with those fall
+    back to the reference op).
+    """
+    if interpret is None:
+        interpret = flash_default_interpret()
+    cfg = _FlashConfig(causal, scale, block_q, block_k, interpret)
+    return _flash(cfg, q, k, v)

@@ -11,6 +11,17 @@ materializes and sequence length scales linearly with the number of devices.
 
 Implementation: ``shard_map`` over the mesh; the per-device body is a
 ``lax.fori_loop`` over ring steps with carry (o, m, l, k, v).
+
+Two per-block implementations:
+
+- ``impl="xla"`` — blockwise jnp math, XLA-fused (default; differentiable
+  by plain autodiff).
+- ``impl="flash"`` — the Pallas flash kernel (pallas/flash_attention.py)
+  runs each (local q, visiting k/v) block, and blocks merge via their
+  log-sum-exp; a ring-level ``custom_vjp`` implements the matching
+  backward as a second ring pass (each block's gradient contribution is
+  independent given the merged lse, so dk/dv accumulators travel around
+  the ring with their k/v blocks).
 """
 
 from __future__ import annotations
@@ -45,6 +56,156 @@ def _block_attn(q, k, v, q_offset, k_offset, *, causal, scale):
     return pv, m, l
 
 
+class _RingFlashConfig:
+    """Hashable statics for the ring-level custom_vjp."""
+
+    __slots__ = ("causal", "scale", "n_ring", "axis_name", "interpret")
+
+    def __init__(self, causal, scale, n_ring, axis_name, interpret):
+        self.causal = causal
+        self.scale = scale
+        self.n_ring = n_ring
+        self.axis_name = axis_name
+        self.interpret = interpret
+
+    def _key(self):
+        return (self.causal, self.scale, self.n_ring, self.axis_name,
+                self.interpret)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return (isinstance(other, _RingFlashConfig)
+                and self._key() == other._key())
+
+
+def _ring_flash_fwd_impl(cfg, q_blk, k_blk, v_blk):
+    """Forward ring pass with the Pallas kernel per block. Per-device
+    shards [b, t_local, h, d] → (out, lse [b, h, t_local])."""
+    from deeplearning4j_tpu.pallas.flash_attention import (
+        MASK_VALUE, flash_attention_fwd)
+
+    n = cfg.n_ring
+    axis = cfg.axis_name
+    my_idx = lax.axis_index(axis)
+    b, tq, h, d = q_blk.shape
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, tq), MASK_VALUE, jnp.float32)
+
+    def block(kc, vc, causal_mode):
+        def full(_):
+            return flash_attention_fwd(
+                q_blk, kc, vc, causal=False, scale=cfg.scale,
+                interpret=cfg.interpret)
+
+        def diag(_):
+            # same-owner block: relative positions align, plain causal
+            return flash_attention_fwd(
+                q_blk, kc, vc, causal=True, scale=cfg.scale,
+                interpret=cfg.interpret)
+
+        def skip(_):
+            return (jnp.zeros((b, tq, h, d), q_blk.dtype),
+                    jnp.full((b, h, tq), MASK_VALUE, jnp.float32))
+
+        if not cfg.causal:
+            return full(None)
+        return lax.switch(causal_mode, [full, diag, skip], None)
+
+    def step(s, carry):
+        o, lse, kc, vc = carry
+        k_owner = (my_idx + s) % n
+        causal_mode = jnp.where(k_owner < my_idx, 0,
+                                jnp.where(k_owner == my_idx, 1, 2))
+        o_blk, lse_blk = block(kc, vc, causal_mode)
+        # log-sum-exp merge of two normalized partial attentions
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_old = jnp.exp(lse - lse_new)   # [b, h, tq]
+        w_new = jnp.exp(lse_blk - lse_new)
+        o = (o * jnp.swapaxes(w_old, 1, 2)[..., None]
+             + o_blk.astype(jnp.float32)
+             * jnp.swapaxes(w_new, 1, 2)[..., None])
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return (o, lse_new, kc, vc)
+
+    o, lse, _, _ = lax.fori_loop(0, n, step, (o0, lse0, k_blk, v_blk))
+    return o.astype(q_blk.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_flash(cfg, q_blk, k_blk, v_blk):
+    out, _ = _ring_flash_fwd_impl(cfg, q_blk, k_blk, v_blk)
+    return out
+
+
+def _ring_flash_fwd_rule(cfg, q_blk, k_blk, v_blk):
+    out, lse = _ring_flash_fwd_impl(cfg, q_blk, k_blk, v_blk)
+    return out, (q_blk, k_blk, v_blk, out, lse)
+
+
+def _ring_flash_bwd_rule(cfg, res, do):
+    """Second ring pass: dq accumulates locally; (dk, dv) accumulators
+    travel with their k/v blocks and arrive home after n rotations."""
+    from deeplearning4j_tpu.pallas.flash_attention import flash_backward
+
+    q_blk, k_blk, v_blk, out, lse = res
+    n = cfg.n_ring
+    axis = cfg.axis_name
+    my_idx = lax.axis_index(axis)
+    b, tq, h, d = q_blk.shape
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def block_grads(kc, vc, causal_mode):
+        def run(causal):
+            return flash_backward(q_blk, kc, vc, out, lse, do,
+                                  causal=causal, scale=cfg.scale)
+
+        def full(_):
+            return run(False)
+
+        def diag(_):
+            return run(True)
+
+        def skip(_):
+            return (jnp.zeros((b, tq, h, d), jnp.float32),
+                    jnp.zeros_like(kc, jnp.float32),
+                    jnp.zeros_like(vc, jnp.float32))
+
+        if not cfg.causal:
+            return full(None)
+        return lax.switch(causal_mode, [full, diag, skip], None)
+
+    def step(s, carry):
+        dq, kc, vc, dkc, dvc = carry
+        k_owner = (my_idx + s) % n
+        causal_mode = jnp.where(k_owner < my_idx, 0,
+                                jnp.where(k_owner == my_idx, 1, 2))
+        dq_c, dk_c, dv_c = block_grads(kc, vc, causal_mode)
+        dq = dq + dq_c
+        dkc = dkc + dk_c
+        dvc = dvc + dv_c
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        dkc = lax.ppermute(dkc, axis, perm)
+        dvc = lax.ppermute(dvc, axis, perm)
+        return (dq, kc, vc, dkc, dvc)
+
+    dq0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(
+        0, n, step,
+        (dq0, k_blk, v_blk, jnp.zeros_like(k_blk, shape=k_blk.shape,
+                                           dtype=jnp.float32),
+         jnp.zeros_like(v_blk, dtype=jnp.float32)))
+    return (dq.astype(q_blk.dtype), dk.astype(k_blk.dtype),
+            dv.astype(v_blk.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -54,21 +215,44 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     axis_name: str = SEQUENCE_AXIS,
+    impl: str = "xla",
+    interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Ring attention over ``axis_name``. q/k/v: [b, t, h, d] GLOBAL arrays
     (sharded or shardable on the time axis); returns [b, t, h, d] sharded the
     same way. Requires t % mesh.shape[axis_name] == 0.
+
+    ``impl="flash"`` runs each block through the Pallas flash kernel with a
+    ring-level custom VJP; ``"xla"`` (default) uses fused jnp blockwise math.
     """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     d = q.shape[-1]
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
     if axis_name not in mesh.shape:
         # size-1 sequence axis is dropped from the mesh: no ring, plain
-        # blockwise attention on the single device
+        # single-device attention
+        if impl == "flash":
+            from deeplearning4j_tpu.pallas.flash_attention import (
+                flash_attention)
+
+            return flash_attention(q, k, v, causal=causal, scale=scale_val,
+                                   interpret=interpret)
         pv, m, l = _block_attn(q, k, v, 0, 0, causal=causal, scale=scale_val)
         denom = jnp.maximum(jnp.swapaxes(l, 1, 2)[..., None], 1e-30)
         return (pv.astype(jnp.float32) / denom).astype(q.dtype)
     n_ring = mesh.shape[axis_name]
     t_local = q.shape[1] // n_ring
+
+    if impl == "flash":
+        cfg = _RingFlashConfig(causal, scale_val, n_ring, axis_name,
+                               interpret)
+        spec = P(None, axis_name, None, None)
+        return shard_map(
+            functools.partial(_ring_flash, cfg), mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
 
     def body(q_blk, k_blk, v_blk):
         # q_blk/k_blk/v_blk: [b, t_local, h, d] — this device's shard

@@ -1,0 +1,143 @@
+"""Recurrent ComputationGraph: TBPTT + stateful rnnTimeStep.
+
+Models the reference's ComputationGraph RNN tests (TBPTT slicing
+ComputationGraph.java:489-534, rnnTimeStep :1285; test strategy per
+MultiLayerTestRNN / ComputationGraphTestRNN).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import BackpropType
+from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _rnn_graph(vocab=12, hidden=8, seed=0, backprop_type=BackpropType.STANDARD,
+               tbptt=8):
+    g = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(0.01).updater(Updater.SGD)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("lstm", L.GravesLSTM(n_in=vocab, n_out=hidden,
+                                        activation="tanh"), "in")
+        .add_layer("out", L.RnnOutputLayer(
+            n_in=hidden, n_out=vocab,
+            loss_function=LossFunction.MCXENT), "lstm")
+        .set_outputs("out")
+        .backprop_type(backprop_type)
+        .t_bptt_forward_length(tbptt)
+        .t_bptt_backward_length(tbptt)
+    )
+    return ComputationGraph(g.build())
+
+
+def _seq_data(batch=4, t=24, vocab=12, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, vocab, (batch, t))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+    return DataSet(x, y)
+
+
+class TestGraphTBPTT:
+    def test_tbptt_window_iterations(self):
+        net = _rnn_graph(backprop_type=BackpropType.TRUNCATED_BPTT,
+                         tbptt=8).init()
+        ds = _seq_data(t=24)
+        net.fit(ds)
+        # 24 steps in windows of 8 → 3 optimizer iterations
+        assert net.iteration_count == 3
+        assert np.isfinite(net.score_value)
+
+    def test_single_window_tbptt_equals_standard(self):
+        # window >= t → TBPTT must take the identical gradient step
+        ds = _seq_data(t=12)
+        std = _rnn_graph(seed=3).init()
+        tb = _rnn_graph(seed=3, backprop_type=BackpropType.TRUNCATED_BPTT,
+                        tbptt=12).init()
+        std.fit(ds)
+        tb.fit(ds)
+        for k, v in std.get_param_table().items():
+            np.testing.assert_allclose(tb.get_param_table()[k], v,
+                                       rtol=1e-6, atol=1e-7,
+                                       err_msg=k)
+
+    def test_tbptt_carries_state_across_windows(self):
+        # two graphs, same params; one sees [0:8]+[8:16] as TBPTT windows,
+        # the other is fed the windows as INDEPENDENT batches. If state
+        # carries, parameters must diverge.
+        ds = _seq_data(t=16, seed=5)
+        tb = _rnn_graph(seed=7, backprop_type=BackpropType.TRUNCATED_BPTT,
+                        tbptt=8).init()
+        indep = _rnn_graph(seed=7).init()
+        tb.fit(ds)
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        indep.fit(DataSet(x[:, :8], y[:, :8]))
+        indep.fit(DataSet(x[:, 8:], y[:, 8:]))
+        diffs = [
+            float(np.max(np.abs(tb.get_param_table()[k]
+                                - indep.get_param_table()[k])))
+            for k in tb.get_param_table()
+        ]
+        assert max(diffs) > 1e-7, "TBPTT state did not carry across windows"
+
+
+class TestGraphRnnTimeStep:
+    def test_stepwise_matches_full_sequence(self):
+        net = _rnn_graph(seed=1).init()
+        ds = _seq_data(batch=3, t=10, seed=2)
+        x = np.asarray(ds.features)
+        full = np.asarray(net.output(x)[0])  # [b, t, vocab]
+
+        net.rnn_clear_previous_state()
+        steps = []
+        for i in range(x.shape[1]):
+            out = net.rnn_time_step(x[:, i, :])[0]  # 2D in → 2D out
+            steps.append(np.asarray(out))
+        stepped = np.stack(steps, axis=1)
+        np.testing.assert_allclose(stepped, full, rtol=1e-5, atol=1e-6)
+
+    def test_clear_state_resets(self):
+        net = _rnn_graph(seed=1).init()
+        x = np.asarray(_seq_data(batch=2, t=6, seed=3).features)
+        first = np.asarray(net.rnn_time_step(x)[0])
+        # carried state → different result on the same input
+        second = np.asarray(net.rnn_time_step(x)[0])
+        assert np.max(np.abs(second - first)) > 1e-6
+        net.rnn_clear_previous_state()
+        reset = np.asarray(net.rnn_time_step(x)[0])
+        np.testing.assert_allclose(reset, first, rtol=1e-6, atol=1e-7)
+
+    def test_recurrent_dag_with_last_time_step_vertex(self):
+        # LSTM → LastTimeStep → OutputLayer: a recurrent DAG classifier
+        vocab, hidden = 8, 6
+        g = (
+            NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.05).updater(Updater.ADAM)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", L.GravesLSTM(n_in=vocab, n_out=hidden,
+                                            activation="tanh"), "in")
+            .add_vertex("last", LastTimeStepVertex("in"), "lstm")
+            .add_layer("out", L.OutputLayer(
+                n_in=hidden, n_out=3,
+                loss_function=LossFunction.MCXENT), "last")
+            .set_outputs("out")
+        )
+        net = ComputationGraph(g.build()).init()
+        rng = np.random.default_rng(0)
+        x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (6, 10))]
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 6)]
+        for _ in range(5):
+            net.fit(DataSet(x, y))
+        assert np.isfinite(net.score_value)
+        out = np.asarray(net.output(x)[0])
+        assert out.shape == (6, 3)

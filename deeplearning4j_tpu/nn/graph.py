@@ -267,35 +267,37 @@ class ComputationGraph:
         return total, (new_state, new_rnn)
 
     # ------------------------------------------------------------------
+    def _step_impl(self, params, updater_state, net_state, iteration,
+                   inputs, labels, feature_masks, label_masks, rng,
+                   rnn_state):
+        """One optimizer step (pure; shared by the per-batch jitted step
+        and the fused TBPTT scan body)."""
+        gc = self.conf.global_conf
+        with dtypes_mod.policy_scope(self._policy):
+            def loss_fn(p):
+                return self._loss_and_state(
+                    p, net_state, inputs, labels, feature_masks,
+                    label_masks, rng, train=True, rnn_state=rnn_state)
+
+            (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            scale = lr_policy_scale(
+                gc.lr_policy, iteration, gc.lr_policy_decay_rate,
+                gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
+                base_lr=gc.learning_rate)
+            new_params, new_updater = {}, {}
+            for name, spec in self.updater_specs.items():
+                steps_i, upd_i = apply_updater(
+                    spec, grads[name], updater_state[name], scale,
+                    iteration + 1)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
+                new_updater[name] = upd_i
+        return new_params, new_updater, new_net_state, loss, new_rnn
+
     @functools.cached_property
     def _train_step(self):
-        gc = self.conf.global_conf
-
-        def step(params, updater_state, net_state, iteration, inputs, labels,
-                 feature_masks, label_masks, rng, rnn_state):
-            with dtypes_mod.policy_scope(self._policy):
-                def loss_fn(p):
-                    return self._loss_and_state(
-                        p, net_state, inputs, labels, feature_masks,
-                        label_masks, rng, train=True, rnn_state=rnn_state)
-
-                (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
-                scale = lr_policy_scale(
-                    gc.lr_policy, iteration, gc.lr_policy_decay_rate,
-                    gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
-                    base_lr=gc.learning_rate)
-                new_params, new_updater = {}, {}
-                for name, spec in self.updater_specs.items():
-                    steps_i, upd_i = apply_updater(
-                        spec, grads[name], updater_state[name], scale,
-                        iteration + 1)
-                    new_params[name] = jax.tree_util.tree_map(
-                        lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
-                    new_updater[name] = upd_i
-            return new_params, new_updater, new_net_state, loss, new_rnn
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
     def _output_fn(self):
@@ -364,13 +366,98 @@ class ComputationGraph:
     # truncated BPTT over the DAG (ComputationGraph.java:489-534
     # doTruncatedBPTT; window slicing + carried stop-gradient state)
     # ------------------------------------------------------------------
+    @functools.cached_property
+    def _tbptt_train_step(self):
+        """Fused TBPTT over the DAG: ``lax.scan`` over full windows in ONE
+        XLA program, rnn carry threaded with stop-gradient truncation at
+        boundaries (see MultiLayerNetwork._tbptt_train_step; reference
+        walks windows host-side — ComputationGraph.java:489-534).
+        Temporal ([b, t, ...]) arrays and [b, t] masks are windowed; static
+        inputs (e.g. an image conditioning a caption LSTM) are closed over
+        whole and reused every window."""
+        window = self.conf.tbptt_fwd_length
+
+        def tbptt(params, updater_state, net_state, iteration0, inputs,
+                  labels, fms, lms, rngs, rnn_state0):
+            t = max(f.shape[1] for f in inputs if f.ndim == 3)
+            n_win = t // window
+
+            def to_windows(a, temporal):
+                if a is None or not temporal:
+                    return None
+                b = a.shape[0]
+                shaped = a.reshape((b, n_win, window) + a.shape[2:])
+                return jnp.moveaxis(shaped, 1, 0)
+
+            in_w = tuple(to_windows(f, f.ndim == 3) for f in inputs)
+            lb_w = tuple(to_windows(l, l.ndim == 3) for l in labels)
+            fm_w = (None if fms is None
+                    else tuple(to_windows(m, True) for m in fms))
+            lm_w = (None if lms is None
+                    else tuple(to_windows(m, True) for m in lms))
+
+            def pick(windowed, whole):
+                return tuple(
+                    w if w is not None else s
+                    for w, s in zip(windowed, whole))
+
+            def body(carry, inp):
+                params, upd, nst, rnn, it = carry
+                iw, lw, fw, lmw, rng = inp
+                p2, u2, nst2, loss, rnn2 = self._step_impl(
+                    params, upd, nst, it, pick(iw, inputs),
+                    pick(lw, labels),
+                    None if fw is None else pick(fw, fms),
+                    None if lmw is None else pick(lmw, lms),
+                    rng, rnn)
+                rnn2 = jax.tree_util.tree_map(jax.lax.stop_gradient, rnn2)
+                return (p2, u2, nst2, rnn2, it + 1), loss
+
+            carry0 = (params, updater_state, net_state, rnn_state0,
+                      iteration0)
+            (p, u, s, rnn, _), losses = jax.lax.scan(
+                body, carry0, (in_w, lb_w, fm_w, lm_w, rngs))
+            return p, u, s, rnn, losses[-1]
+
+        return jax.jit(tbptt, donate_argnums=(0, 1, 2))
+
     def _fit_tbptt(self, mds: MultiDataSet):
+        from deeplearning4j_tpu.nn.conf.enums import LearningRatePolicy
+
         gc = self.conf.global_conf
         t = max(f.shape[1] for f in mds.features if np.ndim(f) == 3)
         window = self.conf.tbptt_fwd_length
         batch = mds.num_examples()
         rnn_state = self._zero_rnn_state(batch)
-        for start in range(0, t, window):
+        n_full = t // window
+        # listeners contractually fire once per window with intermediate
+        # state — fuse only when that contract is unobservable
+        fused_ok = (rnn_state is not None and n_full > 1
+                    and max(1, gc.iterations) == 1
+                    and gc.lr_policy != LearningRatePolicy.SCORE
+                    and not self.listeners)
+        start = 0
+        if fused_ok:
+            head = _slice_mds_time(mds, 0, n_full * window)
+            keys = jax.random.split(self._rng, n_full + 1)
+            self._rng = keys[0]
+            (self.params, self.updater_state, self.net_state, rnn_state,
+             loss) = self._tbptt_train_step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                tuple(jnp.asarray(f) for f in head.features),
+                tuple(jnp.asarray(l) for l in head.labels),
+                None if head.features_masks is None else tuple(
+                    None if m is None else jnp.asarray(m)
+                    for m in head.features_masks),
+                None if head.labels_masks is None else tuple(
+                    None if m is None else jnp.asarray(m)
+                    for m in head.labels_masks),
+                keys[1:], rnn_state)
+            self._score = loss
+            self.iteration_count += n_full
+            start = n_full * window
+        for start in range(start, t, window):
             end = min(start + window, t)
             sub = _slice_mds_time(mds, start, end)
             for _ in range(max(1, gc.iterations)):
@@ -383,7 +470,11 @@ class ComputationGraph:
     def _zero_rnn_state(self, batch: int) -> Optional[Dict[str, Any]]:
         state: Dict[str, Any] = {}
         for name, lc in self.conf.layers.items():
-            if isinstance(lc, (L.GravesLSTM, L.LSTM)):
+            if isinstance(lc, L.ImageLSTM):
+                n = lc.hidden_size or lc.n_out
+                state[name] = {"h": jnp.zeros((batch, n)),
+                               "c": jnp.zeros((batch, n))}
+            elif isinstance(lc, (L.GravesLSTM, L.LSTM)):
                 n = lc.n_out
                 state[name] = {"h": jnp.zeros((batch, n)),
                                "c": jnp.zeros((batch, n))}
@@ -412,6 +503,19 @@ class ComputationGraph:
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
 
+    @functools.cached_property
+    def _rnn_step_fn(self):
+        """Jitted stateful forward (see MultiLayerNetwork._rnn_step_fn)."""
+
+        def step(params, net_state, xs, rnn_state):
+            with dtypes_mod.policy_scope(self._policy):
+                outs, _, new_rnn = self._forward(
+                    params, net_state, xs, train=False, rng=None,
+                    rnn_state=rnn_state)
+            return outs, new_rnn
+
+        return jax.jit(step)
+
     def rnn_time_step(self, *inputs) -> List[jnp.ndarray]:
         """Stateful forward for generation: hidden state carries across
         calls. Inputs may be [b, t, f] or [b, f] (single step); 2D inputs
@@ -423,10 +527,8 @@ class ComputationGraph:
             xs = [x[:, None, :] for x in xs]
         if not getattr(self, "_rnn_state", None):
             self._rnn_state = self._zero_rnn_state(xs[0].shape[0]) or {}
-        with dtypes_mod.policy_scope(self._policy):
-            outs, _, new_rnn = self._forward(
-                self.params, self.net_state, tuple(xs), train=False,
-                rng=None, rnn_state=self._rnn_state)
+        outs, new_rnn = self._rnn_step_fn(
+            self.params, self.net_state, tuple(xs), self._rnn_state)
         if new_rnn:
             self._rnn_state = new_rnn
         if single_step:

@@ -381,14 +381,93 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # truncated BPTT (doTruncatedBPTT :1150)
     # ------------------------------------------------------------------
+    @functools.cached_property
+    def _tbptt_train_step(self):
+        """ALL full TBPTT windows of a batch fused into ONE XLA program:
+        ``lax.scan`` over windows, each window one SGD step with the rnn
+        carry threaded through and ``stop_gradient`` applied at window
+        boundaries (truncation). The sequence transfers to the device once
+        and there is a single host dispatch per batch instead of one per
+        window (reference walks windows host-side —
+        MultiLayerNetwork.java:1150)."""
+        window = self.conf.tbptt_fwd_length
+
+        def tbptt(params, updater_state, net_state, iteration0,
+                  lr_scale_host, x, y, feature_mask, label_mask, rngs,
+                  rnn_state0):
+            b, t = x.shape[0], x.shape[1]
+            n_win = t // window
+
+            def to_windows(a):
+                # 2D labels stay whole per window (DataSet.slice_time
+                # semantics); masks [b, t] and temporal [b, t, f] window
+                if a is None or (a is y and a.ndim == 2):
+                    return None
+                # [b, t, ...] -> [n_win, b, window, ...]
+                shaped = a.reshape((b, n_win, window) + a.shape[2:])
+                return jnp.moveaxis(shaped, 1, 0)
+
+            xs = (to_windows(x), to_windows(y), to_windows(feature_mask),
+                  to_windows(label_mask), rngs)
+
+            def body(carry, inp):
+                params, upd, nst, rnn, it = carry
+                xx, yy, fm, lm, rng = inp
+                yy = y if yy is None else yy
+                p2, u2, s2, rnn2, loss = self._step_impl(
+                    params, upd, nst, it, lr_scale_host, xx, yy, fm, lm,
+                    rng, rnn)
+                rnn2 = jax.tree_util.tree_map(jax.lax.stop_gradient, rnn2)
+                return (p2, u2, s2, rnn2, it + 1), loss
+
+            carry0 = (params, updater_state, net_state, rnn_state0,
+                      iteration0)
+            (p, u, s, rnn, _), losses = jax.lax.scan(body, carry0, xs)
+            return p, u, s, rnn, losses[-1]
+
+        return jax.jit(tbptt, donate_argnums=(0, 1, 2))
+
     def _fit_tbptt(self, ds):
+        gc = self.conf.global_conf
         t = ds.features.shape[1]
         window = self.conf.tbptt_fwd_length
         rnn_state = self._zero_rnn_state(ds.features.shape[0])
-        for start in range(0, t, window):
+        n_full = t // window
+        # fused path: scan over the full windows in one program. Engaged
+        # only when it is OBSERVATIONALLY identical to the host loop:
+        # plain SGD, iterations == 1, non-score-reactive LR policy, and no
+        # listeners (listeners contractually fire once per window with the
+        # intermediate state, which a fused program cannot replay)
+        fused_ok = (rnn_state is not None and n_full > 1
+                    and max(1, gc.iterations) == 1
+                    and gc.lr_policy != LearningRatePolicy.SCORE
+                    and not self.listeners)
+        start = 0
+        if fused_ok:
+            keys = jax.random.split(self._rng, n_full + 1)
+            self._rng = keys[0]
+            (self.params, self.updater_state, self.net_state, rnn_state,
+             loss) = self._tbptt_train_step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                jnp.asarray(self._lr_scale_host, jnp.float32),
+                _dev(ds.features[:, :n_full * window]),
+                _dev(ds.labels[:, :n_full * window]
+                     if ds.labels is not None and ds.labels.ndim == 3
+                     else ds.labels),
+                _dev(None if ds.features_mask is None
+                     else ds.features_mask[:, :n_full * window]),
+                _dev(None if ds.labels_mask is None
+                     else ds.labels_mask[:, :n_full * window]),
+                keys[1:], rnn_state)
+            self._score = loss
+            self._last_input = ds.features
+            self.iteration_count += n_full
+            start = n_full * window
+        for start in range(start, t, window):
             end = min(start + window, t)
             sub = ds.slice_time(start, end)
-            for _ in range(max(1, self.conf.global_conf.iterations)):
+            for _ in range(max(1, gc.iterations)):
                 new_rnn = self._sgd_step(sub, rnn_state=rnn_state)
                 self._post_iteration()
             if new_rnn is not None:
@@ -398,7 +477,11 @@ class MultiLayerNetwork:
     def _zero_rnn_state(self, batch: int) -> Dict[str, Any]:
         state: Dict[str, Any] = {}
         for i, lc in enumerate(self.conf.layers):
-            if isinstance(lc, (L.GravesLSTM, L.LSTM)):
+            if isinstance(lc, L.ImageLSTM):
+                n = lc.hidden_size or lc.n_out
+                state[str(i)] = {"h": jnp.zeros((batch, n)),
+                                 "c": jnp.zeros((batch, n))}
+            elif isinstance(lc, (L.GravesLSTM, L.LSTM)):
                 n = lc.n_out
                 state[str(i)] = {"h": jnp.zeros((batch, n)), "c": jnp.zeros((batch, n))}
             elif isinstance(lc, L.GRU):
@@ -518,6 +601,21 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
 
+    @functools.cached_property
+    def _rnn_step_fn(self):
+        """Jitted stateful forward: one compiled program per (shape,
+        state-structure) signature instead of eager per-op dispatch every
+        generation step (round-2 advisor: rnn_time_step ran op-by-op)."""
+
+        def step(params, net_state, x, rnn_state):
+            with dtypes_mod.policy_scope(self._policy):
+                out, _, new_rnn, _ = self._forward(
+                    params, net_state, x, train=False, rng=None,
+                    rnn_state=rnn_state)
+            return out, new_rnn
+
+        return jax.jit(step)
+
     def rnn_time_step(self, x):
         """x: [b, t, f] (or [b, f] for one step). Carries hidden state across
         calls like BaseRecurrentLayer.stateMap."""
@@ -528,10 +626,8 @@ class MultiLayerNetwork:
             x = x[:, None, :]
         if not self._rnn_state:
             self._rnn_state = self._zero_rnn_state(x.shape[0]) or {}
-        with dtypes_mod.policy_scope(self._policy):
-            out, _, new_rnn, _ = self._forward(
-                self.params, self.net_state, x, train=False, rng=None,
-                rnn_state=self._rnn_state)
+        out, new_rnn = self._rnn_step_fn(
+            self.params, self.net_state, x, self._rnn_state)
         if new_rnn:
             self._rnn_state = new_rnn
         if single_step and out.ndim == 3:

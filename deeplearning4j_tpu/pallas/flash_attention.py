@@ -137,6 +137,14 @@ def flash_attention_fwd(
         interpret = flash_default_interpret()
     b, tq, h, d = q.shape
     tkv = k.shape[1]
+    if causal and tq != tkv:
+        # the kernel's causal mask assumes q row i and k column i are the
+        # SAME absolute position; with tq != tkv that silently mis-masks.
+        # Cross-attention over different spans must use ring_attention /
+        # flash_backward's explicit q_offset/k_offset instead.
+        raise ValueError(
+            f"flash_attention(causal=True) requires tq == tkv (got "
+            f"tq={tq}, tkv={tkv}); self-attention positions must align")
     block_q = min(block_q, -(-tq // 128) * 128)
     block_k = min(block_k, -(-tkv // 128) * 128)
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import fcntl
 import json
+import logging
 import os
 import threading
 import time
@@ -31,6 +32,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from deeplearning4j_tpu.utils.fileio import atomic_write_text
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -384,7 +387,8 @@ class FileStateTracker(StateTracker):
                 try:
                     os.unlink(self._beat_path(w))
                 except FileNotFoundError:
-                    pass
+                    # benign race: another evictor removed the beat first
+                    logger.debug("beat file for %s already removed", w)
         if stale:
             dead = set(stale)
             for j in self.jobs(status="claimed"):
@@ -447,8 +451,10 @@ class FileStateTracker(StateTracker):
             try:
                 out[name] = np.load(
                     os.path.join(self._updates_dir(), name + ".npy"))
-            except (OSError, ValueError):
-                continue  # drained or torn under concurrency: skip
+            except (OSError, ValueError) as e:
+                # drained or torn under concurrency: skip, but say so
+                logger.warning("skipping unreadable update %s: %s", name, e)
+                continue
         return out
 
     def posted_update_keys(self) -> List[str]:
@@ -473,13 +479,14 @@ class FileStateTracker(StateTracker):
                 continue  # another drainer took it
             try:
                 out[name[:-4]] = np.load(grave)
-            except (OSError, ValueError):
-                pass
+            except (OSError, ValueError) as e:
+                # a torn/corrupt update is DROPPED here — make that visible
+                logger.warning("dropping unreadable update %s: %s", name, e)
             finally:
                 try:
                     os.unlink(grave)
                 except FileNotFoundError:
-                    pass
+                    logger.debug("drain grave %s already unlinked", grave)
         return out
 
     # -- binary array metadata --

@@ -141,3 +141,66 @@ class TestGraphRnnTimeStep:
         assert np.isfinite(net.score_value)
         out = np.asarray(net.output(x)[0])
         assert out.shape == (6, 3)
+
+
+class TestFusedTBPTTStaticInput:
+    """Fused TBPTT with a mixed static+temporal input graph: the 2D image
+    input must be re-fed WHOLE to every scanned window while the sequence
+    is sliced (the image-conditioning-a-caption-LSTM shape)."""
+
+    @staticmethod
+    def _captioner(seed):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            DuplicateToTimeSeriesVertex, MergeVertex)
+
+        vocab, hidden, img = 10, 8, 6
+        g = (
+            NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.01).updater(Updater.SGD)
+            .graph_builder()
+            .add_inputs("img", "seq")
+            .add_layer("imgfeat", L.DenseLayer(n_in=img, n_out=4,
+                                               activation="tanh"), "img")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex("seq"), "imgfeat")
+            .add_vertex("cat", MergeVertex(), "seq", "dup")
+            .add_layer("lstm", L.GravesLSTM(n_in=vocab + 4, n_out=hidden,
+                                            activation="tanh"), "cat")
+            .add_layer("out", L.RnnOutputLayer(
+                n_in=hidden, n_out=vocab,
+                loss_function=LossFunction.MCXENT), "lstm")
+            .set_outputs("out")
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(6)
+            .t_bptt_backward_length(6)
+        )
+        return ComputationGraph(g.build())
+
+    def test_fused_matches_window_loop(self):
+        import jax
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        rng = np.random.default_rng(9)
+        b, t, vocab, img = 3, 18, 10, 6
+        idx = rng.integers(0, vocab, (b, t))
+        seq = np.eye(vocab, dtype=np.float32)[idx]
+        y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+        image = rng.random((b, img), np.float32)
+        mds = MultiDataSet([image, seq], [y])
+
+        fused = self._captioner(5).init()
+        fused.fit(mds)  # 3 full windows → fused scan path
+        assert fused.iteration_count == 3
+
+        loop = self._captioner(5).init()
+        from deeplearning4j_tpu.nn.graph import _slice_mds_time
+        rnn_state = loop._zero_rnn_state(b)
+        for start in range(0, t, 6):
+            sub = _slice_mds_time(mds, start, start + 6)
+            new_rnn = loop._one_iteration(sub, rnn_state)
+            rnn_state = jax.tree_util.tree_map(
+                jax.lax.stop_gradient, new_rnn)
+
+        ft, lt = fused.get_param_table(), loop.get_param_table()
+        for k in ft:
+            np.testing.assert_allclose(ft[k], lt[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)

@@ -1,0 +1,299 @@
+"""Reference-format JSON loader: the ecosystem-compat half of the serde
+contract.
+
+A reference ``MultiLayerConfiguration.toJson()`` document (Jackson
+polymorphic serde — /root/reference/deeplearning4j-core/src/main/java/org/
+deeplearning4j/nn/conf/NeuralNetConfiguration.java:214-239 and
+MultiLayerConfiguration.java:48-58) looks like::
+
+    {
+      "backprop": true, "pretrain": false,
+      "backpropType": "TruncatedBPTT",
+      "tbpttFwdLength": 50, "tbpttBackLength": 50,
+      "inputPreProcessors": {"1": {"cnnToFeedForward":
+          {"inputHeight": 12, "inputWidth": 12, "numChannels": 20}}},
+      "confs": [
+        {"layer": {"dense": {"nIn": 784, "nOut": 100,
+                             "activationFunction": "relu",
+                             "weightInit": "XAVIER", "updater": "ADAM",
+                             "learningRate": 0.01, "l2": 1e-4, ...}},
+         "numIterations": 1, "seed": 123,
+         "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+         "learningRatePolicy": "None", ...},
+        ...
+      ]
+    }
+
+Layer/preprocessor/distribution type tags are Jackson WRAPPER_OBJECT names
+(Layer.java:42-60, InputPreProcessor.java @JsonSubTypes,
+Distribution.java:34-37); enums serialize by Java name. This module
+translates that document into the native
+:class:`~deeplearning4j_tpu.nn.conf.neural_net.MultiLayerConfiguration` so a
+model definition exported from the reference loads unchanged
+(``MultiLayerConfiguration.from_reference_json(...)``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import (
+    BackpropType,
+    GradientNormalization,
+    HiddenUnit,
+    LearningRatePolicy,
+    OptimizationAlgorithm,
+    PoolingType,
+    Updater,
+    VisibleUnit,
+    WeightInit,
+)
+from deeplearning4j_tpu.nn.conf.neural_net import GlobalConf, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    BinomialSamplingPreProcessor,
+    CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor,
+    ComposableInputPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    InputPreProcessor,
+    ReshapePreProcessor,
+    RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+    UnitVariancePreProcessor,
+    ZeroMeanAndUnitVariancePreProcessor,
+    ZeroMeanPrePreProcessor,
+)
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+# Jackson WRAPPER_OBJECT names (Layer.java:44-59) → native layer confs
+_LAYER_TYPES: Dict[str, type] = {
+    "autoEncoder": L.AutoEncoder,
+    "convolution": L.ConvolutionLayer,
+    "imageLSTM": L.ImageLSTM,
+    "gravesLSTM": L.GravesLSTM,
+    "gravesBidirectionalLSTM": L.GravesBidirectionalLSTM,
+    "gru": L.GRU,
+    "output": L.OutputLayer,
+    "rnnoutput": L.RnnOutputLayer,
+    "RBM": L.RBM,
+    "dense": L.DenseLayer,
+    "recursiveAutoEncoder": L.RecursiveAutoEncoder,
+    "subsampling": L.SubsamplingLayer,
+    "batchNormalization": L.BatchNormalization,
+    "localResponseNormalization": L.LocalResponseNormalization,
+    "embedding": L.EmbeddingLayer,
+    "activation": L.ActivationLayer,
+}
+
+# reference camelCase layer field → native field (+ optional coercion)
+_FIELD_MAP = {
+    "layerName": "name",
+    "activationFunction": "activation",
+    "weightInit": "weight_init",
+    "biasInit": "bias_init",
+    "learningRate": "learning_rate",
+    "biasLearningRate": "bias_learning_rate",
+    "l1": "l1",
+    "l2": "l2",
+    "dropOut": "dropout",
+    "updater": "updater",
+    "momentum": "momentum",
+    "rho": "rho",
+    "rmsDecay": "rms_decay",
+    "adamMeanDecay": "adam_mean_decay",
+    "adamVarDecay": "adam_var_decay",
+    "gradientNormalization": "gradient_normalization",
+    "gradientNormalizationThreshold": "gradient_normalization_threshold",
+    "nIn": "n_in",
+    "nOut": "n_out",
+    "kernelSize": "kernel_size",
+    "stride": "stride",
+    "padding": "padding",
+    "poolingType": "pooling_type",
+    "lossFunction": "loss_function",
+    "hiddenUnit": "hidden_unit",
+    "visibleUnit": "visible_unit",
+    "k": "k",
+    "sparsity": "sparsity",
+    "decay": "decay",
+    "eps": "eps",
+    "gamma": "gamma",
+    "beta": "beta",
+    "n": "n",
+    "alpha": "alpha",
+    "hiddenSize": "hidden_size",
+    "dist": "dist",
+}
+
+_ENUM_COERCE = {
+    "weight_init": WeightInit,
+    "updater": Updater,
+    "pooling_type": PoolingType,
+    "loss_function": LossFunction,
+    "hidden_unit": HiddenUnit,
+    "visible_unit": VisibleUnit,
+    "gradient_normalization": GradientNormalization,
+}
+
+# fields where Jackson writes 0.0 for "unset" and the native conf expects
+# None to mean "inherit the global/default value"
+_ZERO_MEANS_UNSET = {"learning_rate", "bias_learning_rate", "momentum",
+                     "rho", "rms_decay", "adam_mean_decay", "adam_var_decay"}
+
+
+def _convert_distribution(d: Optional[dict]) -> Optional[dict]:
+    """{"normal": {"mean": m, "std": s}} → {"type": "normal", ...}
+    (Distribution.java:34-37 wrapper names)."""
+    if not d:
+        return None
+    (kind, fields), = d.items()
+    out = {"type": kind}
+    out.update(fields)
+    return out
+
+
+def _convert_layer(wrapped: dict) -> L.LayerConf:
+    if len(wrapped) != 1:
+        raise ValueError(
+            f"expected one Jackson wrapper-object layer key, got {list(wrapped)}")
+    (tag, fields), = wrapped.items()
+    cls = _LAYER_TYPES.get(tag)
+    if cls is None:
+        raise ValueError(f"unknown reference layer type {tag!r} "
+                         f"(known: {sorted(_LAYER_TYPES)})")
+    import dataclasses as _dc
+
+    names = {f.name for f in _dc.fields(cls)}
+    kwargs: Dict[str, Any] = {}
+    for ref_key, value in fields.items():
+        key = _FIELD_MAP.get(ref_key)
+        if key is None or key not in names or value is None:
+            continue
+        if key == "dist":
+            value = _convert_distribution(value)
+        elif key in _ENUM_COERCE and isinstance(value, str):
+            value = _ENUM_COERCE[key](value)
+        elif key in ("kernel_size", "stride", "padding") and isinstance(value, list):
+            value = tuple(value)
+        elif key in _ZERO_MEANS_UNSET and value == 0:
+            continue
+        elif key in ("n_in", "n_out") and value == 0:
+            continue  # Jackson default int; let shape inference fill it
+        kwargs[key] = value
+    return cls(**kwargs)
+
+
+# preprocessor wrapper names (InputPreProcessor.java @JsonSubTypes)
+def _convert_preprocessor(wrapped: dict) -> InputPreProcessor:
+    (tag, fields), = wrapped.items()
+    fields = fields or {}
+    h = fields.get("inputHeight")
+    w = fields.get("inputWidth")
+    c = fields.get("numChannels")
+    if tag == "cnnToFeedForward":
+        return CnnToFeedForwardPreProcessor(height=h, width=w, channels=c)
+    if tag == "feedForwardToCnn":
+        return FeedForwardToCnnPreProcessor(height=h or 0, width=w or 0,
+                                            channels=c or 1)
+    if tag == "cnnToRnn":
+        return CnnToRnnPreProcessor(height=h, width=w, channels=c)
+    if tag == "rnnToCnn":
+        return RnnToCnnPreProcessor(height=h or 0, width=w or 0,
+                                    channels=c or 1)
+    if tag == "feedForwardToRnn":
+        return FeedForwardToRnnPreProcessor()
+    if tag == "rnnToFeedForward":
+        return RnnToFeedForwardPreProcessor()
+    if tag == "reshape":
+        return ReshapePreProcessor(shape=tuple(fields.get("shape", ())))
+    if tag == "unitVariance":
+        return UnitVariancePreProcessor()
+    if tag == "zeroMeanAndUnitVariance":
+        return ZeroMeanAndUnitVariancePreProcessor()
+    if tag == "zeroMean":
+        return ZeroMeanPrePreProcessor()
+    if tag == "binomialSampling":
+        return BinomialSamplingPreProcessor()
+    if tag == "composableInput":
+        children = fields.get("inputPreProcessors", [])
+        return ComposableInputPreProcessor(
+            preprocessors=tuple(_convert_preprocessor(p) for p in children))
+    raise ValueError(f"unknown reference preprocessor type {tag!r}")
+
+
+def from_reference_json(document: str) -> MultiLayerConfiguration:
+    """Load a reference-format ``MultiLayerConfiguration.toJson()`` document
+    (NeuralNetConfiguration.java:214-239 mapper conventions)."""
+    d = json.loads(document)
+    confs = d.get("confs")
+    if not confs:
+        raise ValueError("reference document has no 'confs' list")
+
+    layers = []
+    for conf in confs:
+        layer_doc = conf.get("layer")
+        if layer_doc is None:
+            raise ValueError("conf entry without a 'layer'")
+        layers.append(_convert_layer(layer_doc))
+
+    # network-wide hyperparameters come from the first conf (the reference
+    # clones one NeuralNetConfiguration per layer; trainer-level fields are
+    # replicated across them)
+    first = confs[0]
+    global_conf = GlobalConf(
+        seed=int(first.get("seed", 12345)) & 0x7FFFFFFF,
+        iterations=int(first.get("numIterations", 1)),
+        optimization_algo=_safe_enum(
+            OptimizationAlgorithm, first.get("optimizationAlgo"),
+            OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT),
+        lr_policy=_safe_enum(LearningRatePolicy,
+                             first.get("learningRatePolicy"),
+                             LearningRatePolicy.NONE),
+        lr_policy_decay_rate=float(first.get("lrPolicyDecayRate", 0.0)),
+        lr_policy_steps=float(first.get("lrPolicySteps", 1.0) or 1.0),
+        lr_policy_power=float(first.get("lrPolicyPower", 1.0) or 1.0),
+        max_num_line_search_iterations=int(
+            first.get("maxNumLineSearchIterations", 5)),
+        minibatch=bool(first.get("miniBatch", True)),
+        use_drop_connect=bool(first.get("useDropConnect", False)),
+    )
+    # the reference carries the learning rate on each layer; surface the
+    # first explicit one as the network-wide base LR
+    for layer in layers:
+        if layer.learning_rate is not None:
+            global_conf.learning_rate = float(layer.learning_rate)
+            break
+
+    preprocessors = {
+        int(i): _convert_preprocessor(p)
+        for i, p in (d.get("inputPreProcessors") or {}).items()
+    }
+
+    return MultiLayerConfiguration(
+        global_conf=global_conf,
+        layers=layers,
+        input_preprocessors=preprocessors,
+        backprop=bool(d.get("backprop", True)),
+        pretrain=bool(d.get("pretrain", False)),
+        backprop_type=_safe_enum(BackpropType, d.get("backpropType"),
+                                 BackpropType.STANDARD),
+        tbptt_fwd_length=int(d.get("tbpttFwdLength", 20)),
+        tbptt_back_length=int(d.get("tbpttBackLength", 20)),
+    )
+
+
+def _safe_enum(enum_cls, value, default):
+    if value is None:
+        return default
+    try:
+        return enum_cls(value)
+    except ValueError:
+        # tolerate case-insensitive matches (Jackson writes Java names)
+        for member in enum_cls:
+            if member.value.lower() == str(value).lower() \
+                    or member.name.lower() == str(value).lower():
+                return member
+        raise

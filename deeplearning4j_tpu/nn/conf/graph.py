@@ -253,6 +253,22 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """Block-style YAML (ComputationGraphConfiguration toYaml parity)."""
+        from deeplearning4j_tpu.utils.yamlio import dump
+
+        return dump(self.to_dict())
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        try:
+            return ComputationGraphConfiguration.from_json(s)
+        except json.JSONDecodeError:
+            pass
+        from deeplearning4j_tpu.utils.yamlio import load
+
+        return ComputationGraphConfiguration.from_dict(load(s))
+
     def __eq__(self, other):
         return (
             isinstance(other, ComputationGraphConfiguration)

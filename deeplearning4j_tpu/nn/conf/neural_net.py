@@ -140,9 +140,12 @@ class MultiLayerConfiguration:
         return json.dumps(self.to_dict(), indent=indent)
 
     def to_yaml(self) -> str:
-        # Minimal YAML (JSON is valid YAML); avoids a pyyaml dependency while
-        # honouring the reference's toYaml/fromYaml API surface.
-        return self.to_json(indent=2)
+        """Block-style YAML (reference toYaml parity,
+        NeuralNetConfiguration.java:214-227) via the in-tree YAML-subset
+        emitter (no pyyaml in the image)."""
+        from deeplearning4j_tpu.utils.yamlio import dump
+
+        return dump(self.to_dict())
 
     @staticmethod
     def from_dict(d: dict) -> "MultiLayerConfiguration":
@@ -168,7 +171,27 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
-    from_yaml = from_json
+    @staticmethod
+    def from_reference_json(s: str) -> "MultiLayerConfiguration":
+        """Load a document produced by the REFERENCE's Jackson
+        ``MultiLayerConfiguration.toJson()`` (layer wrapper-object tags,
+        ``activationFunction`` strings, camelCase fields — see
+        ``nn/conf/compat.py``)."""
+        from deeplearning4j_tpu.nn.conf.compat import from_reference_json
+
+        return from_reference_json(s)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        """Parse to_yaml output (also accepts plain JSON, which is valid
+        YAML and was this method's historical input format)."""
+        try:
+            return MultiLayerConfiguration.from_json(s)
+        except json.JSONDecodeError:
+            pass
+        from deeplearning4j_tpu.utils.yamlio import load
+
+        return MultiLayerConfiguration.from_dict(load(s))
 
     def __eq__(self, other):
         return (

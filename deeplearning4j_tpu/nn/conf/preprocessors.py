@@ -199,3 +199,91 @@ class ZeroMeanPrePreProcessor(InputPreProcessor):
 
     def output_type(self, input_type):
         return input_type
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Bernoulli-sample activations with p = x (reference
+    BinomialSamplingPreProcessor: Nd4j createBinomial(1, input).sample).
+    The reference's backprop is identity, so the sample is wrapped
+    straight-through: gradients flow as if the op were identity."""
+
+    needs_rng = True
+
+    def pre_process(self, x, rng=None):
+        import jax
+
+        if rng is None:
+            # eager/inference call without a threaded key: deterministic
+            # fallback (train paths thread a fresh per-step rng)
+            rng = jax.random.PRNGKey(0)
+        sample = jax.random.bernoulli(rng, x).astype(x.dtype)
+        # straight-through: forward the sample, backprop identity
+        return x + jax.lax.stop_gradient(sample - x)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor
+@dataclasses.dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Apply child preprocessors in order (reference
+    ComposableInputPreProcessor; backprop order reversal is implicit under
+    ``jax.grad``). Children serialize nested."""
+
+    preprocessors: tuple = ()
+
+    def __post_init__(self):
+        self.preprocessors = tuple(
+            InputPreProcessor.from_dict(p) if isinstance(p, dict) else p
+            for p in self.preprocessors)
+
+    @property
+    def needs_rng(self):
+        return any(getattr(p, "needs_rng", False) for p in self.preprocessors)
+
+    @property
+    def needs_batch(self):
+        return any(isinstance(p, (FeedForwardToRnnPreProcessor,
+                                  CnnToRnnPreProcessor))
+                   for p in self.preprocessors)
+
+    def pre_process(self, x, batch=None, rng=None):
+        import jax
+
+        for p in self.preprocessors:
+            kwargs = {}
+            if isinstance(p, (FeedForwardToRnnPreProcessor,
+                              CnnToRnnPreProcessor)):
+                kwargs["batch"] = batch
+            if getattr(p, "needs_rng", False):
+                if rng is not None:
+                    rng, kwargs["rng"] = jax.random.split(rng)
+            x = p.pre_process(x, **kwargs)
+        return x
+
+    def output_type(self, input_type):
+        for p in self.preprocessors:
+            input_type = p.output_type(input_type)
+        return input_type
+
+    def to_dict(self) -> dict:
+        return {"type": type(self).__name__,
+                "preprocessors": [p.to_dict() for p in self.preprocessors]}
+
+
+def apply_preprocessor(pre: InputPreProcessor, x, *, batch=None, rng=None):
+    """Apply ``pre`` threading whatever context it needs (minibatch size
+    for FF→RNN folds, a PRNG key for sampling preprocessors). Returns
+    ``(out, rng)`` with ``rng`` advanced if consumed."""
+    import jax
+
+    kwargs = {}
+    if (isinstance(pre, (FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor))
+            or getattr(pre, "needs_batch", False)):
+        kwargs["batch"] = batch
+    if getattr(pre, "needs_rng", False) and rng is not None:
+        rng, kwargs["rng"] = jax.random.split(rng)
+    return pre.pre_process(x, **kwargs), rng

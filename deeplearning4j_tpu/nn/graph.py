@@ -38,9 +38,8 @@ from deeplearning4j_tpu.nn.conf.graph import (
     UnstackVertex,
 )
 from deeplearning4j_tpu.nn.conf.preprocessors import (
-    CnnToRnnPreProcessor,
-    FeedForwardToRnnPreProcessor,
     InputPreProcessor,
+    apply_preprocessor,
 )
 from deeplearning4j_tpu.nn.layers.base import get_layer_impl
 from deeplearning4j_tpu.nn.updater import (
@@ -157,11 +156,7 @@ class ComputationGraph:
                 batch = h.shape[0]
                 pre = conf.preprocessors.get(name)
                 if pre is not None:
-                    if isinstance(pre, (FeedForwardToRnnPreProcessor,
-                                        CnnToRnnPreProcessor)):
-                        h = pre.pre_process(h, batch=batch)
-                    else:
-                        h = pre.pre_process(h)
+                    h, rng = apply_preprocessor(pre, h, batch=batch, rng=rng)
                 sub_rng = None
                 if rng is not None:
                     rng, sub_rng = jax.random.split(rng)

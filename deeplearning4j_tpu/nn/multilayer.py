@@ -31,10 +31,7 @@ from deeplearning4j_tpu.nn.conf.enums import (
     OptimizationAlgorithm,
 )
 from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
-from deeplearning4j_tpu.nn.conf.preprocessors import (
-    CnnToRnnPreProcessor,
-    FeedForwardToRnnPreProcessor,
-)
+from deeplearning4j_tpu.nn.conf.preprocessors import apply_preprocessor
 from deeplearning4j_tpu.nn.layers.base import get_layer_impl
 from deeplearning4j_tpu.nn.updater import (
     UpdaterSpec,
@@ -129,10 +126,7 @@ class MultiLayerNetwork:
         for i, impl in enumerate(self.layers):
             pre = self.conf.input_preprocessors.get(i)
             if pre is not None:
-                if isinstance(pre, (FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor)):
-                    h = pre.pre_process(h, batch=batch)
-                else:
-                    h = pre.pre_process(h)
+                h, rng = apply_preprocessor(pre, h, batch=batch, rng=rng)
             sub_rng = None
             if rng is not None:
                 rng, sub_rng = jax.random.split(rng)
@@ -537,7 +531,7 @@ class MultiLayerNetwork:
         for i in range(stop):
             pre = self.conf.input_preprocessors.get(i)
             if pre is not None:
-                h = pre.pre_process(h)
+                h, _ = apply_preprocessor(pre, h, batch=h.shape[0])
             h, _ = self.layers[i].forward(
                 self.params[str(i)], h, dict(self.net_state.get(str(i), {})),
                 train=False, rng=None)

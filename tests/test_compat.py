@@ -1,0 +1,295 @@
+"""Reference-format JSON compat loader + real YAML serde + sampling/
+composable preprocessors (SURVEY hard-part #7; reference serde contract
+NeuralNetConfiguration.java:214-239)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater, WeightInit
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, PoolingType
+from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    BinomialSamplingPreProcessor,
+    CnnToFeedForwardPreProcessor,
+    ComposableInputPreProcessor,
+    RnnToFeedForwardPreProcessor,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+# Hand-built to the reference's Jackson conventions: WRAPPER_OBJECT layer
+# tags (Layer.java:44-59), camelCase fields, Java enum names.
+REFERENCE_LENET_JSON = json.dumps({
+    "backprop": True,
+    "pretrain": False,
+    "backpropType": "Standard",
+    "tbpttFwdLength": 20,
+    "tbpttBackLength": 20,
+    "inputPreProcessors": {
+        "4": {"cnnToFeedForward":
+              {"inputHeight": 4, "inputWidth": 4, "numChannels": 12}}
+    },
+    "confs": [
+        {"layer": {"convolution": {
+            "nIn": 1, "nOut": 6, "kernelSize": [5, 5], "stride": [1, 1],
+            "padding": [0, 0], "activationFunction": "relu",
+            "weightInit": "XAVIER", "updater": "ADAM",
+            "learningRate": 0.01, "l2": 1e-4, "dropOut": 0.0}},
+         "numIterations": 1, "seed": 42, "miniBatch": True,
+         "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+         "learningRatePolicy": "None"},
+        {"layer": {"subsampling": {
+            "poolingType": "MAX", "kernelSize": [2, 2], "stride": [2, 2],
+            "padding": [0, 0]}},
+         "numIterations": 1, "seed": 42},
+        {"layer": {"convolution": {
+            "nIn": 6, "nOut": 12, "kernelSize": [3, 3], "stride": [1, 1],
+            "padding": [0, 0], "activationFunction": "relu",
+            "updater": "ADAM", "learningRate": 0.01}},
+         "numIterations": 1, "seed": 42},
+        {"layer": {"subsampling": {
+            "poolingType": "MAX", "kernelSize": [2, 2], "stride": [2, 2],
+            "padding": [0, 0]}},
+         "numIterations": 1, "seed": 42},
+        {"layer": {"dense": {
+            "nIn": 192, "nOut": 32, "activationFunction": "relu",
+            "weightInit": "XAVIER", "updater": "ADAM",
+            "learningRate": 0.01}},
+         "numIterations": 1, "seed": 42},
+        {"layer": {"output": {
+            "nIn": 32, "nOut": 10, "activationFunction": "softmax",
+            "lossFunction": "MCXENT", "weightInit": "XAVIER",
+            "updater": "ADAM", "learningRate": 0.01}},
+         "numIterations": 1, "seed": 42},
+    ],
+})
+
+
+class TestReferenceJsonLoader:
+    def test_layer_translation(self):
+        conf = MultiLayerConfiguration.from_reference_json(
+            REFERENCE_LENET_JSON)
+        kinds = [type(l).__name__ for l in conf.layers]
+        assert kinds == ["ConvolutionLayer", "SubsamplingLayer",
+                        "ConvolutionLayer", "SubsamplingLayer",
+                        "DenseLayer", "OutputLayer"]
+        c0 = conf.layers[0]
+        assert (c0.n_in, c0.n_out) == (1, 6)
+        assert c0.kernel_size == (5, 5)
+        assert c0.activation == "relu"
+        assert c0.weight_init == WeightInit.XAVIER
+        assert c0.updater == Updater.ADAM
+        assert c0.l2 == pytest.approx(1e-4)
+        assert conf.layers[1].pooling_type == PoolingType.MAX
+        assert conf.layers[5].loss_function == LossFunction.MCXENT
+        assert conf.global_conf.seed == 42
+        assert conf.global_conf.learning_rate == pytest.approx(0.01)
+        assert conf.backprop_type == BackpropType.STANDARD
+        pre = conf.input_preprocessors[4]
+        assert isinstance(pre, CnnToFeedForwardPreProcessor)
+        assert (pre.height, pre.width, pre.channels) == (4, 4, 12)
+
+    def test_loaded_network_trains(self):
+        conf = MultiLayerConfiguration.from_reference_json(
+            REFERENCE_LENET_JSON)
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((8, 24, 24, 1), np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+        ds = DataSet(x, y)
+        net.fit(ds)
+        s0 = net.score(ds)
+        for _ in range(5):
+            net.fit(ds)
+        assert net.score(ds) < s0
+
+    def test_lstm_tbptt_document(self):
+        doc = json.dumps({
+            "backprop": True, "backpropType": "TruncatedBPTT",
+            "tbpttFwdLength": 8, "tbpttBackLength": 8,
+            "confs": [
+                {"layer": {"gravesLSTM": {
+                    "nIn": 10, "nOut": 16, "activationFunction": "tanh",
+                    "updater": "ADAM", "learningRate": 0.02}},
+                 "seed": 7, "numIterations": 1},
+                {"layer": {"rnnoutput": {
+                    "nIn": 16, "nOut": 10, "activationFunction": "softmax",
+                    "lossFunction": "MCXENT", "updater": "ADAM",
+                    "learningRate": 0.02}},
+                 "seed": 7, "numIterations": 1},
+            ],
+        })
+        conf = MultiLayerConfiguration.from_reference_json(doc)
+        assert conf.backprop_type == BackpropType.TRUNCATED_BPTT
+        assert conf.tbptt_fwd_length == 8
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        idx = rng.integers(0, 10, (4, 16))
+        x = np.eye(10, dtype=np.float32)[idx]
+        y = np.eye(10, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+        net.fit(DataSet(x, y))
+        assert net.iteration_count == 2  # two fused TBPTT windows
+        assert np.isfinite(net.score_value)
+
+    def test_distribution_and_unknown_fields_tolerated(self):
+        doc = json.dumps({
+            "backprop": True,
+            "confs": [{
+                "layer": {"dense": {
+                    "nIn": 4, "nOut": 3, "activationFunction": "tanh",
+                    "weightInit": "DISTRIBUTION",
+                    "dist": {"normal": {"mean": 0.0, "std": 0.5}},
+                    "momentum": 0.9, "someFutureField": 1}},
+                "seed": 1}],
+        })
+        conf = MultiLayerConfiguration.from_reference_json(doc)
+        d = conf.layers[0]
+        assert d.weight_init == WeightInit.DISTRIBUTION
+        assert d.dist == {"type": "normal", "mean": 0.0, "std": 0.5}
+        assert d.momentum == pytest.approx(0.9)
+
+    def test_composable_and_binomial_preprocessor_documents(self):
+        doc = json.dumps({
+            "backprop": True,
+            "inputPreProcessors": {
+                "0": {"binomialSampling": {}},
+                "1": {"composableInput": {"inputPreProcessors": [
+                    {"rnnToFeedForward": {}},
+                    {"zeroMean": {}},
+                ]}},
+            },
+            "confs": [
+                {"layer": {"dense": {"nIn": 6, "nOut": 5,
+                                     "activationFunction": "relu"}},
+                 "seed": 1},
+                {"layer": {"output": {"nIn": 5, "nOut": 2,
+                                      "lossFunction": "MCXENT"}},
+                 "seed": 1},
+            ],
+        })
+        conf = MultiLayerConfiguration.from_reference_json(doc)
+        assert isinstance(conf.input_preprocessors[0],
+                          BinomialSamplingPreProcessor)
+        comp = conf.input_preprocessors[1]
+        assert isinstance(comp, ComposableInputPreProcessor)
+        assert isinstance(comp.preprocessors[0], RnnToFeedForwardPreProcessor)
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            MultiLayerConfiguration.from_reference_json("{}")
+        with pytest.raises(ValueError, match="unknown reference layer"):
+            MultiLayerConfiguration.from_reference_json(json.dumps(
+                {"confs": [{"layer": {"frobnicator": {}}}]}))
+
+
+class TestYamlSerde:
+    def _conf(self):
+        return (
+            NeuralNetConfiguration.Builder()
+            .seed(3).learning_rate(0.02).updater(Updater.ADAM)
+            .list()
+            .layer(0, L.DenseLayer(n_in=7, n_out=5, activation="relu",
+                                   l2=1e-4))
+            .layer(1, L.OutputLayer(n_in=5, n_out=3,
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+        )
+
+    def test_yaml_round_trip(self):
+        conf = self._conf()
+        text = conf.to_yaml()
+        assert ":" in text and "{" not in text.splitlines()[0]  # block style
+        back = MultiLayerConfiguration.from_yaml(text)
+        assert back == conf
+
+    def test_yaml_is_not_json(self):
+        text = self._conf().to_yaml()
+        with pytest.raises(json.JSONDecodeError):
+            json.loads(text)
+
+    def test_from_yaml_accepts_json(self):
+        conf = self._conf()
+        assert MultiLayerConfiguration.from_yaml(conf.to_json()) == conf
+
+    def test_graph_yaml_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+
+        g = (
+            NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", L.DenseLayer(n_in=4, n_out=3), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=3, n_out=2, loss_function=LossFunction.MCXENT), "d")
+            .set_outputs("out")
+        )
+        conf = g.build()
+        back = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+        assert back == conf
+
+
+class TestSamplingPreprocessors:
+    def test_binomial_sampling_forward_and_grad(self):
+        import jax
+        import jax.numpy as jnp
+
+        p = BinomialSamplingPreProcessor()
+        x = jnp.full((64, 32), 0.7)
+        out = p.pre_process(x, rng=jax.random.PRNGKey(0))
+        vals = np.unique(np.asarray(out))
+        assert set(vals.tolist()) <= {0.0, 1.0}
+        assert abs(float(out.mean()) - 0.7) < 0.1
+
+        # straight-through gradient: identity backprop (reference parity)
+        g = jax.grad(lambda v: p.pre_process(
+            v, rng=jax.random.PRNGKey(1)).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+
+    def test_composable_chains_and_infers_types(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+        comp = ComposableInputPreProcessor(preprocessors=(
+            RnnToFeedForwardPreProcessor(),
+        ))
+        x = jnp.ones((2, 5, 3))
+        assert comp.pre_process(x, batch=2).shape == (10, 3)
+        t = comp.output_type(InputType.recurrent(3, 5))
+        assert t.kind == "FF"
+
+    def test_composable_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+
+        comp = ComposableInputPreProcessor(preprocessors=(
+            RnnToFeedForwardPreProcessor(),
+            BinomialSamplingPreProcessor(),
+        ))
+        back = InputPreProcessor.from_dict(comp.to_dict())
+        assert isinstance(back, ComposableInputPreProcessor)
+        assert [type(p).__name__ for p in back.preprocessors] == [
+            "RnnToFeedForwardPreProcessor", "BinomialSamplingPreProcessor"]
+
+    def test_binomial_in_network_trains(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(0).learning_rate(0.05)
+            .list()
+            .layer(0, L.DenseLayer(n_in=12, n_out=8, activation="relu"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=2,
+                                    loss_function=LossFunction.MCXENT))
+            .input_pre_processor(0, BinomialSamplingPreProcessor())
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((16, 12), np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score_value)

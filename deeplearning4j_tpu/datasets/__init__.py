@@ -8,3 +8,12 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     MultipleEpochsIterator,
     SamplingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    CifarDataSetIterator,
+    CurvesDataSetIterator,
+    IrisDataSetIterator,
+    LFWDataSetIterator,
+    MnistDataSetIterator,
+    MovingWindowDataSetIterator,
+    RawMnistDataSetIterator,
+)

@@ -219,6 +219,128 @@ class CurvesDataFetcher(BaseDataFetcher):
         super().__init__(x.astype(np.float32), y, 4, True)
 
 
+class LFWDataFetcher(BaseDataFetcher):
+    """LFW faces (datasets/iterator/impl/LFWDataSetIterator.java: 250×250×3
+    images, one directory per person, label = person).
+
+    Local layout: ``data_dir()/lfw/<person>/<image>.{png,ppm,pgm,npy}``
+    (PNG/PNM decode + nearest-neighbor resize via ``utils/image.py`` — no
+    PIL/JPEG in the zero-egress image). Without local data, a deterministic
+    synthetic surrogate with person-dependent structure is generated.
+    """
+
+    def __init__(self, num_examples: Optional[int] = None,
+                 img_dim: Tuple[int, int] = (250, 250),
+                 num_categories: Optional[int] = None,
+                 use_subset: bool = False, seed: int = 123):
+        from deeplearning4j_tpu.utils import image as image_util
+
+        h, w = img_dim
+        base = os.path.join(data_dir(), "lfw")
+        people = (sorted(
+            d for d in os.listdir(base)
+            if os.path.isdir(os.path.join(base, d)))
+            if os.path.isdir(base) else [])
+        if use_subset:
+            # the reference's useSubset loads the curated "lfw-a" subset;
+            # locally: keep only people with >= 2 images
+            people = [p for p in people if len(
+                os.listdir(os.path.join(base, p))) >= 2]
+        if num_categories is not None:
+            people = people[:num_categories]
+        synthetic = not people
+        if not synthetic:
+            xs, ys = [], []
+            for label, person in enumerate(people):
+                pdir = os.path.join(base, person)
+                for fname in sorted(os.listdir(pdir)):
+                    path = os.path.join(pdir, fname)
+                    try:
+                        if fname.endswith(".npy"):
+                            img = np.load(path).astype(np.float32)
+                            if img.max() > 1.0:
+                                img = img / 255.0
+                        else:
+                            img = image_util.as_matrix(path)
+                    except (ValueError, OSError):
+                        continue  # undecodable format (e.g. JPEG): skip
+                    if img.ndim == 2:
+                        img = np.repeat(img[..., None], 3, axis=-1)
+                    if img.shape[:2] != (h, w):
+                        img = image_util.resize(img, h, w)
+                    xs.append(img[..., :3])
+                    ys.append(label)
+                    if num_examples is not None and len(xs) >= num_examples:
+                        break
+                if num_examples is not None and len(xs) >= num_examples:
+                    break
+            if not xs:
+                synthetic = True  # directories exist but nothing decodable
+            else:
+                x = np.stack(xs).astype(np.float32)
+                y = np.asarray(ys, np.int64)
+                n_classes = len(people)
+        if synthetic:
+            n_classes = num_categories or 10
+            n = min(num_examples or 400, 2000)
+            rng = np.random.default_rng(seed)
+            y = rng.integers(0, n_classes, n)
+            # person-dependent "face": oval + eye offsets parameterized by
+            # the label so classes are separable
+            yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+            cy, cx = h / 2, w / 2
+            x = np.empty((n, h, w, 3), np.float32)
+            for i in range(n):
+                k = float(y[i])
+                oval = (((yy - cy) / (h * (0.30 + 0.02 * (k % 5)))) ** 2
+                        + ((xx - cx) / (w * (0.20 + 0.02 * (k % 7)))) ** 2) < 1
+                img = 0.2 + 0.6 * oval.astype(np.float32)
+                img += rng.normal(0, 0.05, (h, w)).astype(np.float32)
+                x[i] = np.clip(img, 0, 1)[..., None]
+            synthetic = True
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        super().__init__(x, y, n_classes, synthetic)
+
+
+class MovingWindowDataSetFetcher(BaseDataFetcher):
+    """Sliding-window augmentation fetcher
+    (datasets/iterator/impl/MovingWindowDataSetFetcher.java): every example
+    is expanded into its window tiles (plus 3 rot90 variants for square
+    windows, as the reference constructs MovingWindowMatrix with
+    addRotate=true), all merged into one dataset, each tile inheriting the
+    source example's label."""
+
+    def __init__(self, data: DataSet, window_rows: int, window_cols: int):
+        from deeplearning4j_tpu.utils.matrix import MovingWindowMatrix
+
+        feats = np.asarray(data.features, np.float32)
+        labels = np.asarray(data.labels, np.float32)
+        if feats.ndim == 2:  # flattened square images
+            side = int(np.sqrt(feats.shape[1]))
+            imgs = feats.reshape(-1, side, side)
+        elif feats.ndim == 4:
+            imgs = feats[..., 0]
+        else:
+            imgs = feats
+        xs, ys = [], []
+        for i in range(imgs.shape[0]):
+            windows = MovingWindowMatrix(
+                imgs[i], window_rows, window_cols, add_rotate=True
+            ).windows(flattened=feats.ndim == 2)
+            for wdw in windows:
+                xs.append(wdw)
+                ys.append(labels[i])
+        x = np.stack(xs).astype(np.float32)
+        y = np.stack(ys).astype(np.float32)
+        super().__init__(x, y, labels.shape[-1], False)
+
+    def fetch(self, start: int, num: int) -> DataSet:
+        # labels are already one-hot rows (no class-index lookup)
+        return DataSet(self.features[start:start + num],
+                       self.labels[start:start + num])
+
+
 # ---------------------------------------------------------------------------
 # canonical iterators (datasets/iterator/impl/)
 # ---------------------------------------------------------------------------
@@ -231,8 +353,9 @@ class MnistDataSetIterator(BaseDataSetIterator):
         fetcher = MnistDataFetcher(train=train, binarize=binarize,
                                    flatten=flatten, num_examples=num_examples,
                                    seed=seed)
-        super().__init__(batch_size, num_examples or fetcher.total_examples(),
-                         fetcher)
+        super().__init__(batch_size,
+                         min(num_examples or fetcher.total_examples(),
+                             fetcher.total_examples()), fetcher)
 
 
 class IrisDataSetIterator(BaseDataSetIterator):
@@ -246,11 +369,50 @@ class CifarDataSetIterator(BaseDataSetIterator):
     def __init__(self, batch_size: int, num_examples: Optional[int] = None,
                  train: bool = True):
         fetcher = CifarDataFetcher(train=train, num_examples=num_examples)
-        super().__init__(batch_size, num_examples or fetcher.total_examples(),
-                         fetcher)
+        super().__init__(batch_size,
+                         min(num_examples or fetcher.total_examples(),
+                             fetcher.total_examples()), fetcher)
 
 
 class CurvesDataSetIterator(BaseDataSetIterator):
     def __init__(self, batch_size: int, num_examples: int = 2000):
         fetcher = CurvesDataFetcher(num_examples=num_examples)
         super().__init__(batch_size, num_examples, fetcher)
+
+
+class RawMnistDataSetIterator(BaseDataSetIterator):
+    """MNIST without binarization — raw grayscale values
+    (datasets/iterator/impl/RawMnistDataSetIterator.java: fetcher built
+    with binarize=false)."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None):
+        fetcher = MnistDataFetcher(train=True, binarize=False, flatten=True,
+                                   num_examples=num_examples)
+        super().__init__(batch_size,
+                         min(num_examples or fetcher.total_examples(),
+                             fetcher.total_examples()), fetcher)
+
+
+class LFWDataSetIterator(BaseDataSetIterator):
+    """LFW face-recognition iterator (LFWDataSetIterator.java's constructor
+    family: batch, numExamples, imgDim [h, w], numCategories, useSubset)."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 img_dim: Tuple[int, int] = (250, 250),
+                 num_categories: Optional[int] = None,
+                 use_subset: bool = False, seed: int = 123):
+        fetcher = LFWDataFetcher(num_examples=num_examples, img_dim=img_dim,
+                                 num_categories=num_categories,
+                                 use_subset=use_subset, seed=seed)
+        super().__init__(batch_size,
+                         min(num_examples or fetcher.total_examples(),
+                             fetcher.total_examples()), fetcher)
+
+
+class MovingWindowDataSetIterator(BaseDataSetIterator):
+    """Iterator over MovingWindowDataSetFetcher's window-augmented data."""
+
+    def __init__(self, batch_size: int, data: DataSet, window_rows: int,
+                 window_cols: int):
+        fetcher = MovingWindowDataSetFetcher(data, window_rows, window_cols)
+        super().__init__(batch_size, fetcher.total_examples(), fetcher)

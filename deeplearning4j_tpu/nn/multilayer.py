@@ -528,10 +528,13 @@ class MultiLayerNetwork:
         if stop == 0:
             return x
         h = x
+        # entry minibatch size, NOT h.shape[0]: a mid-stack FF→RNN unfold
+        # must use the original batch (h may be time-folded [b*t, f] there)
+        batch = x.shape[0]
         for i in range(stop):
             pre = self.conf.input_preprocessors.get(i)
             if pre is not None:
-                h, _ = apply_preprocessor(pre, h, batch=h.shape[0])
+                h, _ = apply_preprocessor(pre, h, batch=batch)
             h, _ = self.layers[i].forward(
                 self.params[str(i)], h, dict(self.net_state.get(str(i), {})),
                 train=False, rng=None)

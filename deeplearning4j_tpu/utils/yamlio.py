@@ -34,9 +34,10 @@ def _scalar(v: Any) -> str:
         return json.dumps(v)
     s = str(v)
     # quote anything YAML could reinterpret (numbers, booleans, null,
-    # leading specials, colons/hashes)
+    # nan/inf spellings, leading specials, colons/hashes)
     if _PLAIN_RE.match(s) and s.lower() not in (
-            "null", "true", "false", "yes", "no", "on", "off") \
+            "null", "true", "false", "yes", "no", "on", "off",
+            "nan", "inf", "infinity", ".nan", ".inf") \
             and not re.match(r"^[0-9.+-]", s):
         return s
     return json.dumps(s)
@@ -206,6 +207,14 @@ def _parse_seq(rows, i: int, indent: int):
                 item = None
             out.append(item)
             continue
+        # a quoted scalar item ('- "conv: 1"') must not be mistaken for a
+        # mapping: _KEY_RE would lazily match a prefix of the quoted token
+        if body.startswith('"'):
+            qm = re.match(r'^("(?:[^"\\]|\\.)*")\s*(.*)$', body)
+            if qm and qm.group(2) == "":
+                out.append(json.loads(qm.group(1)))
+                i += 1
+                continue
         # inline first entry: '- key: value' starts a nested map whose other
         # keys sit indented under the dash; '- scalar' is a plain item
         m = _KEY_RE.match(body)

@@ -293,3 +293,18 @@ class TestSamplingPreprocessors:
         y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
         net.fit(DataSet(x, y))
         assert np.isfinite(net.score_value)
+
+
+class TestYamlEdgeCases:
+    def test_quoted_colon_string_in_sequence(self):
+        from deeplearning4j_tpu.utils import yamlio
+
+        doc = {"names": ["conv: 1", "plain", 'quo"te'], "n": 3}
+        assert yamlio.load(yamlio.dump(doc)) == doc
+
+    def test_nan_inf_strings_stay_strings(self):
+        from deeplearning4j_tpu.utils import yamlio
+
+        doc = {"name": "nan", "other": "Infinity", "real": 1.5}
+        back = yamlio.load(yamlio.dump(doc))
+        assert back == doc and isinstance(back["name"], str)

@@ -150,3 +150,128 @@ class TestFetchers:
         a = MnistDataSetIterator(batch_size=8, num_examples=8).next()
         b = MnistDataSetIterator(batch_size=8, num_examples=8).next()
         np.testing.assert_array_equal(a.features, b.features)
+
+
+class TestRound4Pipeline:
+    """LFW fetcher, MovingWindow/RawMnist iterators, idx-fixture real-data
+    path (VERDICT r3 item 4)."""
+
+    def test_idx_fixture_real_data_path(self, tmp_path, monkeypatch):
+        """Write real idx-format files and check the NON-synthetic path."""
+        import struct
+
+        n, rows, cols = 12, 28, 28
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (n, rows, cols), dtype=np.uint8)
+        labels = rng.integers(0, 10, n, dtype=np.uint8)
+        mdir = tmp_path / "mnist"
+        mdir.mkdir()
+        with open(mdir / "train-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, rows, cols))
+            f.write(imgs.tobytes())
+        with open(mdir / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+
+        from deeplearning4j_tpu.datasets.fetchers import MnistDataFetcher
+
+        fetcher = MnistDataFetcher(train=True, flatten=False)
+        assert not fetcher.is_synthetic
+        assert fetcher.total_examples() == n
+        np.testing.assert_allclose(
+            fetcher.features[:, :, :, 0], imgs.astype(np.float32) / 255.0)
+        ds = fetcher.fetch(0, 4)
+        assert ds.features.shape == (4, 28, 28, 1)
+        assert np.argmax(np.asarray(ds.labels), -1).tolist() == \
+            labels[:4].tolist()
+
+    def test_raw_mnist_iterator(self):
+        from deeplearning4j_tpu.datasets.fetchers import RawMnistDataSetIterator
+
+        it = RawMnistDataSetIterator(8, num_examples=24)
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].features.shape == (8, 784)
+        # raw values, not binarized
+        vals = np.unique(np.asarray(batches[0].features))
+        assert len(vals) > 2
+
+    def test_lfw_synthetic(self):
+        from deeplearning4j_tpu.datasets.fetchers import LFWDataSetIterator
+
+        it = LFWDataSetIterator(4, num_examples=12, img_dim=(32, 32),
+                                num_categories=5)
+        b = next(iter(it))
+        assert b.features.shape == (4, 32, 32, 3)
+        assert b.labels.shape == (4, 5)
+
+    def test_lfw_local_directory(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.utils.image import save_pgm
+
+        base = tmp_path / "lfw"
+        rng = np.random.default_rng(1)
+        for person in ("alice", "bob"):
+            (base / person).mkdir(parents=True)
+            for i in range(3):
+                img = rng.integers(0, 256, (40, 40), dtype=np.uint8)
+                save_pgm(str(base / person / f"{person}_{i:04d}.pgm"), img)
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+
+        from deeplearning4j_tpu.datasets.fetchers import LFWDataFetcher
+
+        fetcher = LFWDataFetcher(img_dim=(24, 24))
+        assert not fetcher.is_synthetic
+        assert fetcher.total_examples() == 6
+        assert fetcher.num_classes == 2
+        assert fetcher.features.shape == (6, 24, 24, 3)
+        assert sorted(np.unique(fetcher.labels).tolist()) == [0, 1]
+
+    def test_moving_window_matrix(self):
+        from deeplearning4j_tpu.utils.matrix import MovingWindowMatrix
+
+        m = np.arange(16, dtype=np.float32).reshape(4, 4)
+        tiles = MovingWindowMatrix(m, 2, 2).windows()
+        assert len(tiles) == 4
+        np.testing.assert_array_equal(tiles[0], [[0, 1], [4, 5]])
+        rot = MovingWindowMatrix(m, 2, 2, add_rotate=True).windows()
+        assert len(rot) == 16  # each tile + 3 rotations
+        np.testing.assert_array_equal(rot[1], np.rot90(rot[0]))
+        flat = MovingWindowMatrix(m, 2, 2).windows(flattened=True)
+        assert flat[0].shape == (4,)
+
+    def test_moving_window_iterator(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.fetchers import (
+            MovingWindowDataSetIterator)
+
+        rng = np.random.default_rng(0)
+        x = rng.random((3, 28, 28, 1), np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        it = MovingWindowDataSetIterator(8, DataSet(x, y), 14, 14)
+        batches = list(it)
+        total = sum(b.features.shape[0] for b in batches)
+        # 3 examples × 4 tiles × 4 orientations = 48 windows
+        assert total == 48
+        assert batches[0].features.shape[1:] == (14, 14)
+        assert batches[0].labels.shape[1:] == (2,)
+
+    def test_iterator_clamps_to_available(self):
+        from deeplearning4j_tpu.datasets.fetchers import LFWDataSetIterator
+
+        it = LFWDataSetIterator(50, num_examples=5000, img_dim=(16, 16))
+        batches = list(it)
+        assert all(b.features.shape[0] > 0 for b in batches)
+        assert sum(b.features.shape[0] for b in batches) <= 2000
+
+    def test_lfw_undecodable_falls_back_synthetic(self, tmp_path, monkeypatch):
+        base = tmp_path / "lfw" / "alice"
+        base.mkdir(parents=True)
+        (base / "alice_0001.jpg").write_bytes(b"\xff\xd8\xff\xe0JFIFgarbage")
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+
+        from deeplearning4j_tpu.datasets.fetchers import LFWDataFetcher
+
+        fetcher = LFWDataFetcher(img_dim=(16, 16), num_examples=8)
+        assert fetcher.is_synthetic  # nothing decodable → surrogate
+        assert fetcher.total_examples() == 8

@@ -98,23 +98,85 @@ def _full_dataset(it, input_path: str):
     return DataSet.merge(batches)
 
 
+def _make_runtime(runtime: str, net, args, props: Dict[str, str]):
+    """Select the execution runtime (reference: ``-runtime local|hadoop|
+    spark``, cli/subcommands/Train.java:75,128 — re-expressed for TPU as
+    local | mesh | multihost).
+
+    - ``local``      — single-process fit on the default device.
+    - ``mesh``       — data-parallel ``ParallelWrapper`` over a device mesh
+                        (all local devices unless ``runtime.mesh.devices``
+                        / --mesh-devices caps it).
+    - ``multihost``  — join the multi-host JAX runtime first
+                        (``cluster.initialize_distributed``; coordinator/
+                        rank from flags or runtime.* properties), then
+                        data-parallel over the global mesh.
+
+    Returns an object with fit(iterator)/unwrap semantics.
+    """
+    if runtime == "local":
+        return net
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    if runtime == "multihost":
+        from deeplearning4j_tpu.parallel.cluster import (
+            ClusterConfig, initialize_distributed)
+
+        coord = args.coordinator or props.get("runtime.coordinator")
+        nproc = (args.num_processes
+                 if args.num_processes is not None
+                 else int(props.get("runtime.num.processes", "1")))
+        pid = (args.process_id if args.process_id is not None
+               else int(props.get("runtime.process.id", "0")))
+        if nproc > 1 and not coord:
+            raise SystemExit(
+                "-runtime multihost with --num-processes > 1 requires "
+                "--coordinator host:port (or the runtime.coordinator "
+                "property) — refusing to silently train single-process")
+        initialize_distributed(ClusterConfig(
+            coordinator_address=coord, num_processes=nproc, process_id=pid))
+    elif runtime != "mesh":
+        raise SystemExit(f"unknown -runtime {runtime!r} "
+                         "(one of: local, mesh, multihost)")
+    import jax
+
+    n_dev = args.mesh_devices or (
+        int(props["runtime.mesh.devices"])
+        if "runtime.mesh.devices" in props else None)
+    devices = jax.devices()[:n_dev] if n_dev else None
+    mesh = build_mesh(MeshSpec(), devices=devices)
+    return ParallelWrapper(net, mesh=mesh)
+
+
 def cmd_train(args) -> int:
     from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
+    import json
+
     props = load_properties(args.conf) if args.conf else {}
     with open(args.model) as f:
-        conf = MultiLayerConfiguration.from_json(f.read())
+        doc = f.read()
+    # discriminate on document shape, not parse failure: a reference-
+    # exported Jackson document has a top-level "confs" list
+    if "confs" in json.loads(doc):
+        conf = MultiLayerConfiguration.from_reference_json(doc)
+    else:
+        conf = MultiLayerConfiguration.from_json(doc)
     net = MultiLayerNetwork(conf).init()
+    runtime = args.runtime or props.get("runtime", "local")
+    runner = _make_runtime(runtime, net, args, props)
     it = _build_iterator(args, props)
     epochs = (args.epochs if args.epochs is not None
               else int(props.get("epochs", "1")))
     for _ in range(epochs):
         it.reset()
-        net.fit(it)
+        runner.fit(it)
     ModelSerializer.write_model(net, args.output)
-    print(f"model trained ({epochs} epoch(s)) and saved to {args.output}")
+    print(f"model trained ({epochs} epoch(s), runtime={runtime}) "
+          f"and saved to {args.output}")
     return 0
 
 
@@ -185,6 +247,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("-output", "--output", required=True,
                          help="path for the saved model zip")
     p_train.add_argument("--epochs", type=int, default=None)
+    p_train.add_argument("-runtime", "--runtime",
+                         choices=["local", "mesh", "multihost"], default=None,
+                         help="execution runtime (Train.java:75 parity); "
+                              "also the 'runtime' property")
+    p_train.add_argument("--mesh-devices", type=int, default=None,
+                         help="cap the mesh at N devices (default: all)")
+    p_train.add_argument("--coordinator", default=None,
+                         help="multihost coordinator host:port")
+    p_train.add_argument("--num-processes", type=int, default=None)
+    p_train.add_argument("--process-id", type=int, default=None)
     p_train.set_defaults(fn=cmd_train)
 
     p_test = sub.add_parser("test", help="evaluate a saved model")

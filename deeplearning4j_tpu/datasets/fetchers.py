@@ -297,7 +297,6 @@ class LFWDataFetcher(BaseDataFetcher):
                 img = 0.2 + 0.6 * oval.astype(np.float32)
                 img += rng.normal(0, 0.05, (h, w)).astype(np.float32)
                 x[i] = np.clip(img, 0, 1)[..., None]
-            synthetic = True
         if num_examples is not None:
             x, y = x[:num_examples], y[:num_examples]
         super().__init__(x, y, n_classes, synthetic)
@@ -320,6 +319,10 @@ class MovingWindowDataSetFetcher(BaseDataFetcher):
             side = int(np.sqrt(feats.shape[1]))
             imgs = feats.reshape(-1, side, side)
         elif feats.ndim == 4:
+            if feats.shape[-1] != 1:
+                raise ValueError(
+                    f"MovingWindowDataSetFetcher windows single-channel "
+                    f"images; got {feats.shape[-1]} channels")
             imgs = feats[..., 0]
         else:
             imgs = feats

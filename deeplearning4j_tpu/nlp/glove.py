@@ -100,7 +100,9 @@ class Glove:
                  min_word_frequency: int = 1, layer_size: int = 50,
                  window_size: int = 5, learning_rate: float = 0.05,
                  epochs: int = 20, x_max: float = 100.0, alpha: float = 0.75,
-                 batch_size: int = 16384, seed: int = 42):
+                 batch_size: int = 16384, seed: int = 42,
+                 max_memory_pairs: int = 5_000_000,
+                 spill_dir: Optional[str] = None):
         self.sentence_iterator = sentence_iterator
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
         self.min_word_frequency = min_word_frequency
@@ -112,6 +114,9 @@ class Glove:
         self.alpha = alpha
         self.batch_size = batch_size
         self.seed = seed
+        self.max_memory_pairs = max_memory_pairs
+        self.spill_dir = spill_dir
+        self.spill_count = 0  # shards written during the last count pass
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[np.ndarray] = None  # w + wc merged after fit
         self._rng = np.random.default_rng(seed)
@@ -122,8 +127,39 @@ class Glove:
             yield self.tokenizer_factory.create(s).get_tokens()
 
     def count_cooccurrences(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Windowed, distance-weighted counts (AbstractCoOccurrences)."""
+        """Windowed, distance-weighted counts with DISK SPILL: when the
+        in-memory map reaches ``max_memory_pairs``, it is flushed to a
+        sorted shard on disk and the counting map restarts empty; shards
+        are streamed back through a k-way heap merge that sums duplicate
+        keys (the role of AbstractCoOccurrences.java:624's countMap +
+        count/ spill files, redesigned around sorted-run external
+        aggregation instead of a disk-backed hash map)."""
+        import heapq
+        import os
+        import tempfile
+
         counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        shards: List[str] = []
+        spill_root: Optional[str] = None
+
+        def spill():
+            nonlocal spill_root
+            if spill_root is None:
+                spill_root = self.spill_dir or tempfile.mkdtemp(
+                    prefix="glove-cooc-")
+                os.makedirs(spill_root, exist_ok=True)
+            keys = np.asarray(list(counts.keys()), np.int64)  # [m, 2]
+            vals = np.asarray(list(counts.values()), np.float32)
+            order = np.lexsort((keys[:, 1], keys[:, 0]))
+            # a single sortable key per pair lets the merge compare scalars;
+            # plain .npy files (not npz) so the merge can mmap them
+            packed = (keys[order, 0] << 32) | keys[order, 1]
+            base = os.path.join(spill_root, f"shard-{len(shards):05d}")
+            np.save(base + ".keys.npy", packed)
+            np.save(base + ".x.npy", vals[order])
+            shards.append(base)
+            counts.clear()
+
         for tokens in self._sentences_tokens():
             idx = [self.vocab.index_of(t) for t in tokens]
             idx = [i for i in idx if i >= 0]
@@ -135,10 +171,74 @@ class Glove:
                     weight = 1.0 / off
                     counts[(wi, idx[j])] += weight
                     counts[(idx[j], wi)] += weight
-        rows = np.asarray([k[0] for k in counts], np.int32)
-        cols = np.asarray([k[1] for k in counts], np.int32)
-        x = np.asarray(list(counts.values()), np.float32)
-        return rows, cols, x
+            if len(counts) >= self.max_memory_pairs:
+                spill()
+
+        self.spill_count = len(shards) + (1 if shards and counts else 0)
+        if not shards:  # everything fit in memory: fast path
+            rows = np.asarray([k[0] for k in counts], np.int32)
+            cols = np.asarray([k[1] for k in counts], np.int32)
+            x = np.asarray(list(counts.values()), np.float32)
+            return rows, cols, x
+
+        if counts:
+            spill()
+
+        chunk = 65536
+
+        def shard_stream(base):
+            # mmap: only the pages of the current chunk are resident
+            ks = np.load(base + ".keys.npy", mmap_mode="r")
+            vs = np.load(base + ".x.npy", mmap_mode="r")
+            for s in range(0, len(ks), chunk):
+                kb = np.asarray(ks[s:s + chunk])
+                vb = np.asarray(vs[s:s + chunk])
+                for t in range(len(kb)):
+                    yield (int(kb[t]), float(vb[t]))
+
+        # buffered output: grow in fixed-size numpy blocks, not boxed lists
+        key_blocks: List[np.ndarray] = []
+        val_blocks: List[np.ndarray] = []
+        kbuf = np.empty((chunk,), np.int64)
+        vbuf = np.empty((chunk,), np.float32)
+        fill = 0
+
+        def flush():
+            nonlocal fill
+            key_blocks.append(kbuf[:fill].copy())
+            val_blocks.append(vbuf[:fill].copy())
+            fill = 0
+
+        cur_key: Optional[int] = None
+        cur_val = 0.0
+        for k, v in heapq.merge(*(shard_stream(p) for p in shards)):
+            if k == cur_key:
+                cur_val += v
+            else:
+                if cur_key is not None:
+                    if fill == chunk:
+                        flush()
+                    kbuf[fill] = cur_key
+                    vbuf[fill] = cur_val
+                    fill += 1
+                cur_key, cur_val = k, v
+        if cur_key is not None:
+            if fill == chunk:
+                flush()
+            kbuf[fill] = cur_key
+            vbuf[fill] = cur_val
+            fill += 1
+        flush()
+        for p in shards:
+            for suffix in (".keys.npy", ".x.npy"):
+                try:
+                    os.unlink(p + suffix)
+                except OSError:
+                    pass
+        packed = np.concatenate(key_blocks)
+        return ((packed >> 32).astype(np.int32),
+                (packed & 0xFFFFFFFF).astype(np.int32),
+                np.concatenate(val_blocks))
 
     def fit(self) -> "Glove":
         if self.vocab is None:

@@ -26,6 +26,7 @@ with the params, matching the reference's UpdaterAggregator.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Optional
 
 import numpy as np
@@ -36,6 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu import dtypes as dtypes_mod
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.updater import apply_updater, lr_policy_scale
+
+logger = logging.getLogger(__name__)
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, build_mesh
 
 
@@ -93,9 +96,14 @@ class ParallelWrapper:
         net = self.network
         dp = self.data_parallelism
         if ds.num_examples() % dp:
-            raise ValueError(
-                f"batch size {ds.num_examples()} not divisible by data-parallel "
-                f"degree {dp}")
+            # ragged tail batch (e.g. last CSV batch): run it unsharded on
+            # the network's own path — params are replicated, so the step
+            # is exact; only this batch loses the mesh speedup
+            logger.debug(
+                "batch of %d not divisible by dp=%d; running unsharded",
+                ds.num_examples(), dp)
+            net.fit(ds)
+            return
         with self.mesh:
             net._rng, rng = jax.random.split(net._rng)
             (net.params, net.updater_state, net.net_state, _, loss) = net._train_step(
@@ -115,6 +123,37 @@ class ParallelWrapper:
             x = self._shard_batch(x)  # else: unsharded fallback
         with self.mesh:
             return self.network.output(x)
+
+    # -- model-like surface so trainers (early stopping, solvers) can use
+    #    the wrapper interchangeably with the wrapped network (the role of
+    #    BaseSparkEarlyStoppingTrainer's SparkDl4jMultiLayer handle,
+    #    spark/.../BaseSparkEarlyStoppingTrainer.java:301) ---------------
+    @property
+    def score_value(self) -> float:
+        return self.network.score_value
+
+    def score(self, ds) -> float:
+        """Scoring forward sharded over the mesh (no host gather: the
+        sharded device arrays feed the jitted score fn directly)."""
+        net = self.network
+        if (ds.num_examples() % self.data_parallelism
+                or not hasattr(net, "_score_fn")):
+            return net.score(ds)
+        with self.mesh:
+            val = net._score_fn(
+                net.params, net.net_state,
+                self._shard_batch(ds.features), self._shard_batch(ds.labels),
+                self._shard_batch(ds.features_mask),
+                self._shard_batch(ds.labels_mask))
+        net.score_value = val
+        return net.score_value
+
+    def clone(self):
+        return self.network.clone()
+
+    @property
+    def conf(self):
+        return self.network.conf
 
     def evaluate(self, data):
         """Distributed evaluation: each batch's forward shards over the

@@ -231,3 +231,42 @@ class TestVectorizers:
         ds = v.vectorize(self.DOCS, labels=[0, 1, 0], num_classes=2)
         assert ds.features.shape == (3, v.vocab.num_words())
         np.testing.assert_array_equal(ds.labels.sum(axis=1), [1, 1, 1])
+
+
+class TestGloveDiskSpill:
+    def test_spill_matches_in_memory_counts(self, tmp_path):
+        corpus = topic_corpus() * 6
+        mem = (Glove.Builder()
+               .iterate(CollectionSentenceIterator(corpus))
+               .min_word_frequency(1).layer_size(8).window_size(3)
+               .epochs(1).seed(1).build())
+        mem.vocab = None
+        from deeplearning4j_tpu.nlp.vocab import build_vocab
+        mem.vocab = build_vocab(mem._sentences_tokens(), 1)
+        r1, c1, x1 = mem.count_cooccurrences()
+        assert mem.spill_count == 0
+
+        spill = Glove(CollectionSentenceIterator(corpus),
+                      min_word_frequency=1, layer_size=8, window_size=3,
+                      epochs=1, seed=1, max_memory_pairs=7,
+                      spill_dir=str(tmp_path / "cooc"))
+        spill.vocab = build_vocab(spill._sentences_tokens(), 1)
+        r2, c2, x2 = spill.count_cooccurrences()
+        assert spill.spill_count > 1  # multiple shards actually written
+
+        def as_map(r, c, x):
+            return {(int(a), int(b)): float(v) for a, b, v in zip(r, c, x)}
+
+        m1, m2 = as_map(r1, c1, x1), as_map(r2, c2, x2)
+        assert set(m1) == set(m2)
+        for k in m1:
+            assert abs(m1[k] - m2[k]) < 1e-4, k
+
+    def test_spilled_glove_still_learns(self, tmp_path):
+        glove = Glove(CollectionSentenceIterator(topic_corpus()),
+                      min_word_frequency=1, layer_size=16, window_size=3,
+                      epochs=25, seed=1, max_memory_pairs=5,
+                      spill_dir=str(tmp_path / "cooc"))
+        glove.fit()
+        assert glove.spill_count > 0
+        assert glove.similarity("cat", "dog") > glove.similarity("cat", "gpu")

@@ -87,11 +87,14 @@ class TestParallelWrapper:
         wrapper.fit(ListDataSetIterator(ds, batch_size=64), num_epochs=20)
         assert net.evaluate(ds).accuracy() > 0.9
 
-    def test_indivisible_batch_rejected(self):
+    def test_indivisible_batch_falls_back_unsharded(self):
+        """A ragged batch (e.g. a CSV's final partial batch) trains via the
+        network's own unsharded step instead of crashing mid-epoch."""
         net = mlp()
         wrapper = ParallelWrapper(net)
-        with pytest.raises(ValueError, match="not divisible"):
-            wrapper.fit(toy(n=30))
+        wrapper.fit(toy(n=30))  # 30 % 8 != 0
+        assert net.iteration_count == 1
+        assert np.isfinite(net.score_value)
 
 
 class TestParameterAveraging:

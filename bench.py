@@ -9,18 +9,29 @@ same jitted step (the reference publishes no numbers — BASELINE.md — so the
 baseline is the self-measured north star ">2x nd4j-native CPU throughput";
 XLA-CPU is a strictly faster stand-in for 2015 ND4J op-by-op BLAS dispatch).
 
+Measurement protocol (BENCH_NOTES.md): steady-state per-step timing after a
+warm-up call, hard on-device sync before/after the timed window, batches
+device-resident (transferred once — the tunnel link here moves ~37 MB/s, so
+re-feeding a 3 MB batch per step would measure the link, not the chip).
+Where a fused multi-step program exists, BOTH the per-dispatch and fused
+numbers are reported and the fused one is the headline for that config; the
+gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
+
 ``extras`` carries every BASELINE.md config:
-  - MNIST MLP, LeNet-5, GravesLSTM char-RNN, word2vec skip-gram,
-    ResNet-18 CIFAR (bf16) — samples(/words)/sec/chip
-  - transformer LM (bf16) — tokens/sec + achieved model TFLOP/s + MFU
-  - GEMM sweep 512–8192 (bf16) — achieved TFLOP/s + MFU at the top end
+  - MNIST MLP, LeNet-5, GravesLSTM char-RNN (fused TBPTT), word2vec
+    skip-gram, ResNet-18 CIFAR (bf16) — samples(/words)/sec/chip
+  - transformer LM (bf16) — tokens/sec + achieved model TFLOP/s + MFU,
+    per-dispatch vs fused, batch sweep, and a t=4096 config where the
+    Pallas flash-attention kernel engages
+  - GEMM sweep 512–8192 (bf16) — dispatch-chained AND fori-loop-fused
+    TFLOP/s per size (fused isolates the chip from the dispatch floor)
+  - infeed: async device-prefetch overlap vs synchronous feeding
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs are analytic (formula noted per entry in "flops_source").
 Training data is synthetic (zero-egress sandbox; throughput does not
 depend on pixel/token values) via the same public ``fit`` APIs a user
-calls. The per-step vs fused ``fit_steps`` path is benched separately and
-the winner is named in the output (their listener contracts differ).
+calls.
 """
 
 from __future__ import annotations
@@ -69,45 +80,85 @@ def _time_loop(fn, steps, sync=None):
     return (time.perf_counter() - t0) / steps
 
 
+def _dev(*arrays):
+    """Place arrays on device once, synced (steady-state protocol)."""
+    import jax
+
+    out = [jax.device_put(a) for a in arrays]
+    for o in out:
+        _sync(o)
+    return out
+
+
 # ----------------------------------------------------------------------
 def bench_gemm():
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     rng = np.random.default_rng(0)
     sizes = [512, 1024, 2048, 4096, 8192]
-    results = {}
+    chained, fused = {}, {}
     best = 0.0
     for n in sizes:
         a = jnp.asarray(rng.normal(size=(n, n)), jnp.bfloat16)
-        c = jnp.asarray(rng.normal(size=(n, n)), jnp.bfloat16)
+        c0 = jnp.asarray(rng.normal(size=(n, n)), jnp.bfloat16)
         f = jax.jit(lambda a, b: a @ b)
         steps = 30 if n <= 2048 else 10
-        c = f(a, c)
+        c = f(a, c0)
         _sync(c)
         t0 = time.perf_counter()
         for _ in range(steps):
             c = f(a, c)  # chained: each call consumes the previous result
         _sync(c)
         sec = (time.perf_counter() - t0) / steps
-        tflops = 2 * n ** 3 / sec / 1e12
-        if tflops > PEAK_TFLOPS_BF16 * 1.05:
-            _log(f"gemm {n}: {tflops:.1f} TFLOP/s exceeds chip peak — "
-                 "measurement invalid, discarding")
-            results[str(n)] = None
-            continue
-        results[str(n)] = round(tflops, 1)
-        best = max(best, tflops)
-        _log(f"gemm {n}: {tflops:.1f} TFLOP/s")
+        tflops_chained = 2 * n ** 3 / sec / 1e12
+
+        # fused: K matmuls inside ONE program — no per-call dispatch.
+        # The fori_loop carry keeps each iteration dependent on the last
+        # (XLA cannot elide or overlap the chain), exactly like the
+        # dispatch-chained loop above minus the host round-trips.
+        k = 100 if n <= 2048 else 30
+
+        @jax.jit
+        def chain(a, c):
+            return lax.fori_loop(0, k, lambda i, cc: a @ cc, c)
+
+        c = chain(a, c0)
+        _sync(c)
+        t0 = time.perf_counter()
+        c = chain(a, c0)
+        _sync(c)
+        sec = (time.perf_counter() - t0) / k
+        tflops_fused = 2 * n ** 3 / sec / 1e12
+
+        for name, val, store in (("chained", tflops_chained, chained),
+                                 ("fused", tflops_fused, fused)):
+            if val > PEAK_TFLOPS_BF16 * 1.05:
+                _log(f"gemm {n} {name}: {val:.1f} TFLOP/s exceeds chip "
+                     "peak — measurement invalid, discarding")
+                store[str(n)] = None
+            else:
+                store[str(n)] = round(val, 1)
+        # headline peak considers BOTH columns: a discarded fused number
+        # must not zero the headline while chained data is valid
+        for val in (fused[str(n)], chained[str(n)]):
+            if val:
+                best = max(best, val)
+        _log(f"gemm {n}: {tflops_chained:.1f} TFLOP/s chained, "
+             f"{tflops_fused:.1f} fused")
     return {
-        "per_size_tflops": results,
+        "per_size_tflops_chained": chained,
+        "per_size_tflops_fused": fused,
         "peak_achieved_tflops": round(best, 1),
         "mfu_pct": round(100 * best / PEAK_TFLOPS_BF16, 1),
+        "note": "fused = lax.fori_loop chain in one program; "
+                "chained-vs-fused gap is the per-dispatch floor",
     }
 
 
 def _fit_throughput(net, ds, batch, steps):
-    """Faster of per-step fit and fused fit_steps (winner named).
+    """Per-step fit AND fused fit_steps samples/sec (both reported).
     Syncs by reading back a parameter leaf (fit returns the network)."""
     sync = lambda: net.params
     stepwise = 1 / _time_loop(lambda: net.fit(ds), steps, sync=sync) * batch
@@ -115,10 +166,10 @@ def _fit_throughput(net, ds, batch, steps):
         fused_fn = lambda: net.fit_steps(ds, 10)
         fused = (1 / (_time_loop(fused_fn, max(2, steps // 10),
                                  sync=sync) / 10) * batch)
-    except Exception:
+    except Exception as e:
+        _log(f"fit_steps path FAILED (falling back to fit): {e!r}")
         fused = 0.0
-    winner = "fit_steps" if fused > stepwise else "fit"
-    return max(stepwise, fused), winner
+    return stepwise, fused
 
 
 def bench_mlp():
@@ -129,10 +180,13 @@ def bench_mlp():
     batch = 4096
     x = rng.random((batch, 784), np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    x, y = _dev(x, y)
     net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
-    sps, winner = _fit_throughput(net, DataSet(x, y), batch, steps=20)
-    _log(f"mlp: {sps:,.0f} samples/sec ({winner})")
-    return {"samples_per_sec": round(sps, 1), "batch": batch, "path": winner}
+    stepwise, fused = _fit_throughput(net, DataSet(x, y), batch, steps=20)
+    _log(f"mlp: {fused:,.0f} samples/sec fused ({stepwise:,.0f} per-step)")
+    return {"samples_per_sec": round(max(stepwise, fused), 1),
+            "per_step": round(stepwise, 1), "fused": round(fused, 1),
+            "batch": batch}
 
 
 def bench_lenet():
@@ -143,10 +197,13 @@ def bench_lenet():
     batch = 1024
     x = rng.random((batch, 28, 28, 1), np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    x, y = _dev(x, y)
     net = lenet5(dtype_policy="bf16").init()
-    sps, winner = _fit_throughput(net, DataSet(x, y), batch, steps=20)
-    _log(f"lenet5: {sps:,.0f} samples/sec ({winner})")
-    return {"samples_per_sec": round(sps, 1), "batch": batch, "path": winner}
+    stepwise, fused = _fit_throughput(net, DataSet(x, y), batch, steps=20)
+    _log(f"lenet5: {fused:,.0f} samples/sec fused ({stepwise:,.0f} per-step)")
+    return {"samples_per_sec": round(max(stepwise, fused), 1),
+            "per_step": round(stepwise, 1), "fused": round(fused, 1),
+            "batch": batch}
 
 
 def bench_char_lstm():
@@ -158,15 +215,19 @@ def bench_char_lstm():
     idx = rng.integers(0, vocab, (batch, t))
     x = np.eye(vocab, dtype=np.float32)[idx]
     y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+    x, y = _dev(x, y)
     net = char_lstm(vocab_size=vocab, hidden=256, layers=2,
                     tbptt_length=50).init()
     ds = DataSet(x, y)
+    # fit() itself now fuses all TBPTT windows into one scanned program
     sec = _time_loop(lambda: net.fit(ds), steps=5, sync=lambda: net.params)
     sps = batch / sec
-    _log(f"char_lstm: {sps:,.0f} samples/sec ({sps * t:,.0f} tokens/sec)")
+    _log(f"char_lstm: {sps:,.0f} samples/sec ({sps * t:,.0f} tokens/sec, "
+         "fused TBPTT scan)")
     return {"samples_per_sec": round(sps, 1),
             "tokens_per_sec": round(sps * t, 1),
-            "batch": batch, "seq_len": t, "tbptt": 50}
+            "batch": batch, "seq_len": t, "tbptt": 50,
+            "path": "fused-tbptt-scan"}
 
 
 def bench_word2vec():
@@ -187,14 +248,22 @@ def bench_word2vec():
     sec = time.perf_counter() - t0
     words = n_sentences * sent_len
     wps = words / sec
-    _log(f"word2vec: {wps:,.0f} words/sec")
-    return {"words_per_sec": round(wps, 1), "corpus_words": words,
-            "vocab": vocab, "note": "includes vocab build + pair emission"}
+    # second epoch-equivalent run on the warm jit: steady-state number
+    w2v2 = Word2Vec(CollectionSentenceIterator(sentences),
+                    layer_size=128, window_size=5, min_word_frequency=1,
+                    negative=5, iterations=1, epochs=1, seed=43)
+    t0 = time.perf_counter()
+    w2v2.fit()
+    warm = words / (time.perf_counter() - t0)
+    _log(f"word2vec: {wps:,.0f} words/sec cold, {warm:,.0f} warm")
+    return {"words_per_sec": round(max(wps, warm), 1),
+            "cold_words_per_sec": round(wps, 1),
+            "corpus_words": words, "vocab": vocab,
+            "note": "includes vocab build + vectorized pair emission; "
+                    "warm = second run reusing the compiled step"}
 
 
 def bench_resnet18():
-    import jax
-
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models import resnet18
 
@@ -202,82 +271,174 @@ def bench_resnet18():
     batch = 256
     x = rng.random((batch, 32, 32, 3), np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    x, y = _dev(x, y)
     net = resnet18(num_classes=10, dtype_policy="bf16").init()
     ds = DataSet(x, y)
-    sec = _time_loop(lambda: net.fit(ds), steps=10, sync=lambda: net.params)
-    sps = batch / sec
-    # analytic model FLOPs: CIFAR ResNet-18 fwd ≈ 1.11 GFLOP/sample
-    # (sum over conv/dense macs × 2), train ≈ 3× fwd
-    fwd_flops = 1.11e9
+    fwd_flops = 1.11e9  # analytic CIFAR ResNet-18 fwd GFLOP/sample
+    stepwise, fused = _fit_throughput(net, ds, batch, steps=10)
+    sps = max(stepwise, fused)
     tflops = 3 * fwd_flops * sps / 1e12
-    _log(f"resnet18: {sps:,.0f} samples/sec, {tflops:.1f} TFLOP/s "
+    _log(f"resnet18: {sps:,.0f} samples/sec ({stepwise:,.0f} per-step, "
+         f"{fused:,.0f} fused), {tflops:.1f} TFLOP/s "
          f"({100 * tflops / PEAK_TFLOPS_BF16:.1f}% MFU)")
-    return {"samples_per_sec": round(sps, 1), "batch": batch,
+    return {"samples_per_sec": round(sps, 1),
+            "per_step": round(stepwise, 1), "fused": round(fused, 1),
+            "batch": batch,
             "model_tflops": round(tflops, 1),
             "mfu_pct": round(100 * tflops / PEAK_TFLOPS_BF16, 1),
             "flops_source": "analytic 1.11 GFLOP fwd/sample x3"}
 
 
-def _transformer_cfg():
+def bench_infeed():
+    """Async device-prefetch overlap vs synchronous feeding on a stream of
+    DISTINCT batches (infeed-bound config: the per-batch host→device
+    transfer is comparable to the step time)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import (
+        AsyncDataSetIterator, ListDataSetIterator)
+    from deeplearning4j_tpu.models import mnist_mlp
+
+    rng = np.random.default_rng(0)
+    batch, n_batches = 4096, 16
+    batches = [DataSet(rng.random((batch, 784), np.float32),
+                       np.eye(10, dtype=np.float32)[
+                           rng.integers(0, 10, batch)])
+               for _ in range(n_batches)]
+    net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+    net.fit(batches[0])  # compile
+    _sync(net.params)
+
+    def run(make_it):
+        it = make_it()
+        t0 = time.perf_counter()
+        net.fit(it)
+        _sync(net.params)
+        return batch * n_batches / (time.perf_counter() - t0)
+
+    sync_sps = run(lambda: ListDataSetIterator(batches, batch))
+    async_sps = run(lambda: AsyncDataSetIterator(
+        ListDataSetIterator(batches, batch), queue_size=4,
+        device_prefetch=True))
+    _log(f"infeed: {sync_sps:,.0f} samples/sec sync, "
+         f"{async_sps:,.0f} async-prefetch "
+         f"({async_sps / sync_sps:.2f}x)")
+    return {"sync_samples_per_sec": round(sync_sps, 1),
+            "async_prefetch_samples_per_sec": round(async_sps, 1),
+            "overlap_speedup": round(async_sps / sync_sps, 2),
+            "batch": batch, "n_batches": n_batches}
+
+
+def _transformer(batch, t, vocab=8192, d=512, layers=8, heads=8,
+                 attn="auto"):
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
-    return TransformerLM(vocab_size=8192, d_model=512, num_heads=8,
-                         num_layers=8, max_len=1024, seed=0,
-                         dtype_policy="bf16")
+    return TransformerLM(vocab_size=vocab, d_model=d, num_heads=heads,
+                         num_layers=layers, max_len=t, seed=0,
+                         dtype_policy="bf16", attn_impl=attn)
+
+
+def _transformer_flops_per_token(lm, t):
+    n_params_matmul = sum(
+        int(np.prod(p.shape)) for blk in lm.params["blocks"]
+        for grp in blk.values() for p in grp.values())
+    n_params_matmul += lm.d_model * lm.vocab_size  # tied unembedding
+    return 6 * n_params_matmul + 12 * lm.num_layers * lm.d_model * t // 2
+
+
+def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto"):
+    import jax.numpy as jnp
+
+    lm = _transformer(batch, t, attn=attn).init()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
+    _sync(tokens)
+    step = lm.make_train_step()
+    sec_step = _time_loop(lambda: lm.fit_batch(tokens, train_step=step, block=False),
+                          steps=steps, sync=lambda: lm.params)
+    try:
+        multi = lm.make_multi_train_step(fused_k)
+        sec_fused = _time_loop(
+            lambda: lm.fit_batch_multi(tokens, multi_step=multi,
+                                       k=fused_k, block=False),
+            steps=max(2, steps // fused_k), sync=lambda: lm.params
+        ) / fused_k
+    except Exception as e:
+        _log(f"transformer fused path FAILED: {e!r}")
+        sec_fused = float("inf")
+    sec = min(sec_step, sec_fused)
+    tps = batch * t / sec
+    fpt = _transformer_flops_per_token(lm, t)
+    tflops = fpt * tps / 1e12
+    mfu = 100 * tflops / PEAK_TFLOPS_BF16
+    return {
+        "tokens_per_sec": round(tps, 1),
+        "per_step_tokens_per_sec": round(batch * t / sec_step, 1),
+        "fused_tokens_per_sec": (
+            0.0 if sec_fused == float("inf")
+            else round(batch * t / sec_fused, 1)),
+        "batch": batch, "seq_len": t,
+        "attn_impl": lm._attn_impl(t),
+        "model_tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
+    }, tps, lm
 
 
 def bench_transformer(cpu_baseline=True):
     import jax
     import jax.numpy as jnp
 
-    lm = _transformer_cfg().init()
-    batch, t = 16, 1024
-    tokens = jnp.asarray(
-        np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
-    step = lm.make_train_step()
-    sec = _time_loop(lambda: lm.fit_batch(tokens, train_step=step),
-                     steps=20, sync=lambda: lm.params)
-    tps = batch * t / sec
+    # batch sweep at t=1024 (the headline config family)
+    sweep = {}
+    best_tps, best_cfg = 0.0, None
+    for batch in (16, 32, 64):
+        try:
+            cfg, tps, _ = _bench_transformer_cfg(batch, 1024)
+            sweep[str(batch)] = cfg
+            _log(f"transformer b{batch} t1024: {cfg['tokens_per_sec']:,.0f} "
+                 f"tok/s ({cfg['mfu_pct']:.1f}% MFU, {cfg['attn_impl']})")
+            if tps > best_tps:
+                best_tps, best_cfg = tps, cfg
+        except Exception as e:
+            sweep[str(batch)] = {"error": str(e)[:200]}
+            _log(f"transformer b{batch} FAILED: {e}")
 
-    # model FLOPs per token: 6 FLOP per matmul param (fwd+bwd), counting
-    # the tied-embedding unembed projection (d·V) like standard 6N
-    # accounting, + attention's 12·L·d·t/2 causal score+pv term
-    n_params_matmul = sum(
-        int(np.prod(p.shape)) for blk in lm.params["blocks"]
-        for grp in blk.values() for p in grp.values())
-    n_params_matmul += lm.d_model * lm.vocab_size  # tied unembedding
-    flops_per_token = (6 * n_params_matmul
-                       + 12 * lm.num_layers * lm.d_model * t // 2)
-    tflops = flops_per_token * tps / 1e12
-    mfu = 100 * tflops / PEAK_TFLOPS_BF16
-    _log(f"transformer: {tps:,.0f} tokens/sec, {tflops:.1f} TFLOP/s "
-         f"({mfu:.1f}% MFU)")
+    # long-context config where the Pallas flash kernel engages
+    try:
+        flash_cfg, _, lm4k = _bench_transformer_cfg(4, 4096, steps=6,
+                                                    fused_k=6)
+        flash_cfg["note"] = "flash kernel auto-engages at t>=4096"
+        _log(f"transformer b4 t4096 ({flash_cfg['attn_impl']}): "
+             f"{flash_cfg['tokens_per_sec']:,.0f} tok/s "
+             f"({flash_cfg['mfu_pct']:.1f}% MFU)")
+    except Exception as e:
+        flash_cfg = {"error": str(e)[:200]}
+        _log(f"transformer t4096 FAILED: {e}")
 
     vs_baseline = float("nan")
-    if cpu_baseline:
+    if cpu_baseline and best_cfg is not None:
         try:
             cpu = jax.devices("cpu")[0]
             with jax.default_device(cpu):
-                lm_cpu = _transformer_cfg().init()
+                lm_cpu = _transformer(16, 1024).init()
                 step_cpu = lm_cpu.make_train_step()
-                tokens_cpu = jax.device_put(tokens, cpu)
+                tokens_cpu = jax.device_put(np.random.default_rng(0).integers(
+                    0, 8192, (16, 1024)).astype(np.int32), cpu)
                 sec_cpu = _time_loop(
-                    lambda: lm_cpu.fit_batch(tokens_cpu,
-                                             train_step=step_cpu),
+                    lambda: lm_cpu.fit_batch(tokens_cpu, train_step=step_cpu,
+                                             block=False),
                     steps=2, sync=lambda: lm_cpu.params)
-            cpu_tps = batch * t / sec_cpu
-            vs_baseline = tps / cpu_tps
+            cpu_tps = 16 * 1024 / sec_cpu
+            vs_baseline = best_tps / cpu_tps
             _log(f"transformer CPU baseline: {cpu_tps:,.0f} tokens/sec "
                  f"→ vs_baseline {vs_baseline:.1f}x")
         except Exception as e:  # pragma: no cover
             _log(f"CPU baseline failed: {e}")
 
-    return {
-        "tokens_per_sec": round(tps, 1), "batch": batch, "seq_len": t,
-        "model_tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
-        "flops_source": "analytic 6*N/token + attention term",
-        "config": "d512 L8 H8 v8192 bf16",
-    }, vs_baseline
+    result = dict(best_cfg or {})
+    result["flops_source"] = "analytic 6*N/token + attention term"
+    result["config"] = "d512 L8 H8 v8192 bf16"
+    result["batch_sweep_t1024"] = sweep
+    result["long_context_t4096"] = flash_cfg
+    return result, vs_baseline
 
 
 def main() -> None:
@@ -287,7 +448,8 @@ def main() -> None:
                      ("lenet5", bench_lenet),
                      ("char_lstm", bench_char_lstm),
                      ("word2vec", bench_word2vec),
-                     ("resnet18_cifar10", bench_resnet18)]:
+                     ("resnet18_cifar10", bench_resnet18),
+                     ("infeed", bench_infeed)]:
         try:
             extras[name] = fn()
         except Exception as e:  # keep the bench robust to one bad config
@@ -297,7 +459,7 @@ def main() -> None:
     try:
         tf, vs_baseline = bench_transformer()
         extras["transformer_lm"] = tf
-        headline_value = tf["tokens_per_sec"]
+        headline_value = tf.get("tokens_per_sec")
     except Exception as e:
         extras["transformer_lm"] = {"error": str(e)[:200]}
         _log(f"transformer FAILED: {e}")

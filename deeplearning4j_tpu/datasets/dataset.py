@@ -17,12 +17,23 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def _as_batch_array(a):
+    """Keep ndarray-like values AS-IS — crucially including jax device
+    arrays, so device-resident batches (async-iterator prefetch, repeated
+    benchmark batches) are NOT gathered back to host by construction;
+    ``np.asarray`` here would silently re-transfer every batch at every
+    ``fit`` through the host↔device link. Lists/scalars still coerce."""
+    if a is None:
+        return None
+    return a if hasattr(a, "dtype") and hasattr(a, "shape") else np.asarray(a)
+
+
 class DataSet:
     def __init__(self, features, labels=None, features_mask=None, labels_mask=None):
-        self.features = np.asarray(features)
-        self.labels = None if labels is None else np.asarray(labels)
-        self.features_mask = None if features_mask is None else np.asarray(features_mask)
-        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.features = _as_batch_array(features)
+        self.labels = _as_batch_array(labels)
+        self.features_mask = _as_batch_array(features_mask)
+        self.labels_mask = _as_batch_array(labels_mask)
 
     def num_examples(self) -> int:
         return int(self.features.shape[0])
@@ -105,15 +116,15 @@ class MultiDataSet:
     def __init__(self, features: Sequence, labels: Sequence,
                  features_masks: Optional[Sequence] = None,
                  labels_masks: Optional[Sequence] = None):
-        self.features = [np.asarray(f) for f in features]
-        self.labels = [np.asarray(l) for l in labels]
+        self.features = [_as_batch_array(f) for f in features]
+        self.labels = [_as_batch_array(l) for l in labels]
         self.features_masks = (
             None if features_masks is None
-            else [None if m is None else np.asarray(m) for m in features_masks]
+            else [_as_batch_array(m) for m in features_masks]
         )
         self.labels_masks = (
             None if labels_masks is None
-            else [None if m is None else np.asarray(m) for m in labels_masks]
+            else [_as_batch_array(m) for m in labels_masks]
         )
 
     def num_examples(self) -> int:

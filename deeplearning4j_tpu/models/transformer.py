@@ -156,8 +156,10 @@ class TransformerLM:
         return jnp.mean(nll)
 
     # ------------------------------------------------------------------
-    def make_train_step(self, *, mesh: Optional[Mesh] = None,
-                        sequence_parallel: bool = False, donate: bool = True):
+    def _step_body(self, *, mesh: Optional[Mesh] = None,
+                   sequence_parallel: bool = False):
+        """Un-jitted single optimizer step (shared by the per-step jit and
+        the fused multi-step scan)."""
         lr = self.lr
         b1, b2, eps = 0.9, 0.999, 1e-8
 
@@ -184,9 +186,36 @@ class TransformerLM:
             new_state = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
             return new_params, new_state, loss
 
+        return step
+
+    def make_train_step(self, *, mesh: Optional[Mesh] = None,
+                        sequence_parallel: bool = False, donate: bool = True):
+        step = self._step_body(mesh=mesh, sequence_parallel=sequence_parallel)
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
-    def fit_batch(self, tokens, train_step=None):
+    def make_multi_train_step(self, k: int, *, mesh: Optional[Mesh] = None,
+                              sequence_parallel: bool = False,
+                              donate: bool = True):
+        """K optimizer steps fused into ONE XLA program (``lax.scan`` over
+        the shared step body): one host dispatch + one token transfer per
+        K steps, isolating the chip from the per-dispatch floor."""
+        step = self._step_body(mesh=mesh, sequence_parallel=sequence_parallel)
+
+        def multi(params, opt_state, tokens, step_count):
+            def body(carry, _):
+                p, s, c = carry
+                p, s, loss = step(p, s, tokens, c)
+                return (p, s, c + 1), loss
+
+            (p, s, _), losses = jax.lax.scan(
+                body, (params, opt_state, step_count), None, length=k)
+            return p, s, losses[-1]
+
+        return jax.jit(multi, donate_argnums=(0, 1) if donate else ())
+
+    def fit_batch(self, tokens, train_step=None, block: bool = True):
+        """``block=False`` returns the on-device loss scalar without a
+        host round-trip, letting steps pipeline (read it when needed)."""
         if self.params is None:
             self.init()
         train_step = train_step or self._default_step
@@ -194,7 +223,18 @@ class TransformerLM:
             self.params, self.opt_state, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(self.step_count, jnp.int32))
         self.step_count += 1
-        return float(loss)
+        return float(loss) if block else loss
+
+    def fit_batch_multi(self, tokens, *, multi_step, k: int,
+                        block: bool = True):
+        """Run a fused K-step program (see ``make_multi_train_step``)."""
+        if self.params is None:
+            self.init()
+        self.params, self.opt_state, loss = multi_step(
+            self.params, self.opt_state, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(self.step_count, jnp.int32))
+        self.step_count += k
+        return float(loss) if block else loss
 
     @functools.cached_property
     def _default_step(self):

@@ -130,11 +130,10 @@ class Glove:
         """Windowed, distance-weighted counts with DISK SPILL: when the
         in-memory map reaches ``max_memory_pairs``, it is flushed to a
         sorted shard on disk and the counting map restarts empty; shards
-        are streamed back through a k-way heap merge that sums duplicate
-        keys (the role of AbstractCoOccurrences.java:624's countMap +
+        are merged back with a vectorized chunk-wise k-way merge that sums
+        duplicate keys (the role of AbstractCoOccurrences.java:624's countMap +
         count/ spill files, redesigned around sorted-run external
         aggregation instead of a disk-backed hash map)."""
-        import heapq
         import os
         import tempfile
 
@@ -184,51 +183,45 @@ class Glove:
         if counts:
             spill()
 
-        chunk = 65536
-
-        def shard_stream(base):
-            # mmap: only the pages of the current chunk are resident
-            ks = np.load(base + ".keys.npy", mmap_mode="r")
-            vs = np.load(base + ".x.npy", mmap_mode="r")
-            for s in range(0, len(ks), chunk):
-                kb = np.asarray(ks[s:s + chunk])
-                vb = np.asarray(vs[s:s + chunk])
-                for t in range(len(kb)):
-                    yield (int(kb[t]), float(vb[t]))
-
-        # buffered output: grow in fixed-size numpy blocks, not boxed lists
+        # vectorized chunk-wise k-way merge of the sorted runs: per round,
+        # take every element <= the minimum of the shards' chunk-max keys
+        # (guaranteeing round-completeness per key), sort the <= k*chunk
+        # gathered elements, and aggregate duplicates with add.reduceat —
+        # O(k*chunk) resident, no per-pair Python loop
+        chunk = 1 << 17
+        keys_mm = [np.load(p + ".keys.npy", mmap_mode="r") for p in shards]
+        vals_mm = [np.load(p + ".x.npy", mmap_mode="r") for p in shards]
+        sizes = [len(k) for k in keys_mm]
+        pos = [0] * len(shards)
         key_blocks: List[np.ndarray] = []
         val_blocks: List[np.ndarray] = []
-        kbuf = np.empty((chunk,), np.int64)
-        vbuf = np.empty((chunk,), np.float32)
-        fill = 0
-
-        def flush():
-            nonlocal fill
-            key_blocks.append(kbuf[:fill].copy())
-            val_blocks.append(vbuf[:fill].copy())
-            fill = 0
-
-        cur_key: Optional[int] = None
-        cur_val = 0.0
-        for k, v in heapq.merge(*(shard_stream(p) for p in shards)):
-            if k == cur_key:
-                cur_val += v
-            else:
-                if cur_key is not None:
-                    if fill == chunk:
-                        flush()
-                    kbuf[fill] = cur_key
-                    vbuf[fill] = cur_val
-                    fill += 1
-                cur_key, cur_val = k, v
-        if cur_key is not None:
-            if fill == chunk:
-                flush()
-            kbuf[fill] = cur_key
-            vbuf[fill] = cur_val
-            fill += 1
-        flush()
+        while True:
+            live = [i for i in range(len(shards)) if pos[i] < sizes[i]]
+            if not live:
+                break
+            bound = min(
+                keys_mm[i][min(pos[i] + chunk, sizes[i]) - 1] for i in live)
+            parts_k, parts_v = [], []
+            for i in live:
+                window = np.asarray(
+                    keys_mm[i][pos[i]:min(pos[i] + chunk, sizes[i])])
+                take = int(np.searchsorted(window, bound, side="right"))
+                if take:
+                    parts_k.append(window[:take])
+                    parts_v.append(
+                        np.asarray(vals_mm[i][pos[i]:pos[i] + take]))
+                    pos[i] += take
+            merged_k = np.concatenate(parts_k)
+            merged_v = np.concatenate(parts_v)
+            order = np.argsort(merged_k, kind="stable")
+            merged_k = merged_k[order]
+            merged_v = merged_v[order]
+            starts = np.flatnonzero(
+                np.concatenate(([True], merged_k[1:] != merged_k[:-1])))
+            key_blocks.append(merged_k[starts])
+            val_blocks.append(
+                np.add.reduceat(merged_v.astype(np.float64), starts)
+                .astype(np.float32))
         for p in shards:
             for suffix in (".keys.npy", ".x.npy"):
                 try:

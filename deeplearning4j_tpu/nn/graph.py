@@ -295,6 +295,68 @@ class ComputationGraph:
         return jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
 
     @functools.cached_property
+    def _multi_train_step(self):
+        """K optimizer steps fused into ONE XLA program via ``lax.scan``
+        (the ComputationGraph counterpart of
+        MultiLayerNetwork._multi_train_step): the batch transfers once and
+        there is a single host dispatch per K steps."""
+
+        def multi(params, updater_state, net_state, iteration0, inputs,
+                  labels, feature_masks, label_masks, rngs, rnn_state):
+            def body(carry, rng):
+                params, upd, nst, rnn, it = carry
+                p2, u2, s2, loss, rnn2 = self._step_impl(
+                    params, upd, nst, it, inputs, labels, feature_masks,
+                    label_masks, rng, rnn)
+                return (p2, u2, s2, rnn2, it + 1), loss
+
+            carry0 = (params, updater_state, net_state, rnn_state,
+                      iteration0)
+            (p, u, s, rnn, _), losses = jax.lax.scan(body, carry0, rngs)
+            return p, u, s, losses[-1]
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def fit_steps(self, data, n_steps: int):
+        """``fit(data)`` called ``n_steps`` times, fused into one XLA
+        program (see MultiLayerNetwork.fit_steps: same contract —
+        listeners fire once after the block with the final score).
+        Falls back to a plain loop for TBPTT/temporal batches."""
+        self._ensure_init()
+        gc = self.conf.global_conf
+        if isinstance(data, DataSet):
+            data = MultiDataSet.from_dataset(data)
+        from deeplearning4j_tpu.nn.conf.enums import BackpropType
+
+        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                and any(np.ndim(f) == 3 for f in data.features)):
+            for _ in range(n_steps):
+                self.fit(data)
+            return self
+        total = n_steps * max(1, gc.iterations)
+        keys = jax.random.split(self._rng, total + 1)
+        self._rng = keys[0]
+        (self.params, self.updater_state, self.net_state, loss) = (
+            self._multi_train_step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                tuple(jnp.asarray(f) for f in data.features),
+                tuple(jnp.asarray(l) for l in data.labels),
+                None if data.features_masks is None else tuple(
+                    None if m is None else jnp.asarray(m)
+                    for m in data.features_masks),
+                None if data.labels_masks is None else tuple(
+                    None if m is None else jnp.asarray(m)
+                    for m in data.labels_masks),
+                keys[1:], None,
+            ))
+        self._score = loss
+        self.iteration_count += total
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+        return self
+
+    @functools.cached_property
     def _output_fn(self):
         def out(params, net_state, inputs):
             with dtypes_mod.policy_scope(self._policy):

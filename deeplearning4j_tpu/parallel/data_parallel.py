@@ -96,13 +96,15 @@ class ParallelWrapper:
         net = self.network
         dp = self.data_parallelism
         if ds.num_examples() % dp:
-            # ragged tail batch (e.g. last CSV batch): run it unsharded on
-            # the network's own path — params are replicated, so the step
-            # is exact; only this batch loses the mesh speedup
+            # ragged tail batch (e.g. last CSV batch): ONE unsharded
+            # optimizer step — same per-batch step count as the sharded
+            # path (net.fit would run gc.iterations steps and over-weight
+            # the smallest batch); params are replicated, so it is exact
             logger.debug(
                 "batch of %d not divisible by dp=%d; running unsharded",
                 ds.num_examples(), dp)
-            net.fit(ds)
+            net._sgd_step(ds)
+            net._post_iteration()
             return
         with self.mesh:
             net._rng, rng = jax.random.split(net._rng)

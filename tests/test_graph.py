@@ -254,3 +254,48 @@ class TestReviewRegressions:
         it = WeightedRandomWalkIterator(g, walk_length=1, seed=0)
         with pytest.raises(ValueError):
             list(it)
+
+
+class TestGraphFitSteps:
+    """ComputationGraph.fit_steps: K steps fused via lax.scan must follow
+    the same parameter trajectory as K fit() calls (dropout-free nets)."""
+
+    @staticmethod
+    def _toy_graph(seed):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        g = (
+            NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.05).updater(Updater.SGD)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", L.DenseLayer(n_in=6, n_out=8,
+                                         activation="tanh"), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=8, n_out=3, loss_function=LossFunction.MCXENT), "d")
+            .set_outputs("out")
+        )
+        return ComputationGraph(g.build())
+
+    def test_fused_matches_stepwise(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        ds = DataSet(x, y)
+
+        a = self._toy_graph(5).init()
+        for _ in range(6):
+            a.fit(ds)
+        b = self._toy_graph(5).init()
+        b.fit_steps(ds, 6)
+        assert a.iteration_count == b.iteration_count == 6
+        ta, tb = a.get_param_table(), b.get_param_table()
+        for k in ta:
+            np.testing.assert_allclose(tb[k], ta[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)

@@ -143,3 +143,40 @@ class TestGraftEntry:
         import __graft_entry__ as ge
 
         ge.dryrun_multichip(4)
+
+
+class TestTransformerMultiStep:
+    def test_fused_k_steps_match_stepwise(self):
+        import numpy as np
+        import jax
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        kw = dict(vocab_size=128, d_model=32, num_heads=4, num_layers=2,
+                  max_len=32, seed=3)
+        tok = np.random.default_rng(0).integers(0, 128, (2, 32)).astype(
+            np.int32)
+        a = TransformerLM(**kw).init()
+        sa = a.make_train_step(donate=False)
+        for _ in range(4):
+            a.fit_batch(tok, train_step=sa)
+        b = TransformerLM(**kw).init()
+        mb = b.make_multi_train_step(4, donate=False)
+        b.fit_batch_multi(tok, multi_step=mb, k=4)
+        assert a.step_count == b.step_count == 4
+        for pa, pb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(pb), np.asarray(pa),
+                                       rtol=2e-4, atol=2e-5)
+
+
+class TestDeviceResidentDataSet:
+    def test_dataset_preserves_device_arrays(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        x = jax.device_put(np.ones((4, 3), np.float32))
+        ds = DataSet(x, [0.0, 1.0, 0.0, 1.0])
+        assert isinstance(ds.features, jnp.ndarray)
+        assert isinstance(ds.labels, np.ndarray)  # list still coerces

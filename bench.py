@@ -328,8 +328,7 @@ def bench_infeed():
             "batch": batch, "n_batches": n_batches}
 
 
-def _transformer(batch, t, vocab=8192, d=512, layers=8, heads=8,
-                 attn="auto"):
+def _transformer(t, vocab=8192, d=512, layers=8, heads=8, attn="auto"):
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
     return TransformerLM(vocab_size=vocab, d_model=d, num_heads=heads,
@@ -348,7 +347,7 @@ def _transformer_flops_per_token(lm, t):
 def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto"):
     import jax.numpy as jnp
 
-    lm = _transformer(batch, t, attn=attn).init()
+    lm = _transformer(t, attn=attn).init()
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
     _sync(tokens)
@@ -413,12 +412,15 @@ def bench_transformer(cpu_baseline=True):
         flash_cfg = {"error": str(e)[:200]}
         _log(f"transformer t4096 FAILED: {e}")
 
+    # vs_baseline is strictly like-for-like: the b16 t1024 TPU number over
+    # the SAME config on XLA-CPU (the sweep's best batch may differ)
+    b16_tps = (sweep.get("16") or {}).get("tokens_per_sec", 0.0) or 0.0
     vs_baseline = float("nan")
-    if cpu_baseline and best_cfg is not None:
+    if cpu_baseline and b16_tps:
         try:
             cpu = jax.devices("cpu")[0]
             with jax.default_device(cpu):
-                lm_cpu = _transformer(16, 1024).init()
+                lm_cpu = _transformer(1024).init()
                 step_cpu = lm_cpu.make_train_step()
                 tokens_cpu = jax.device_put(np.random.default_rng(0).integers(
                     0, 8192, (16, 1024)).astype(np.int32), cpu)
@@ -427,7 +429,7 @@ def bench_transformer(cpu_baseline=True):
                                              block=False),
                     steps=2, sync=lambda: lm_cpu.params)
             cpu_tps = 16 * 1024 / sec_cpu
-            vs_baseline = best_tps / cpu_tps
+            vs_baseline = b16_tps / cpu_tps
             _log(f"transformer CPU baseline: {cpu_tps:,.0f} tokens/sec "
                  f"→ vs_baseline {vs_baseline:.1f}x")
         except Exception as e:  # pragma: no cover

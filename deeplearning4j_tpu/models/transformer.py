@@ -142,8 +142,13 @@ class TransformerLM:
                             + blk["mlp"]["b1"])
             h = h + x @ policy.cast_compute(blk["mlp"]["w2"]) + blk["mlp"]["b2"]
         h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
-        logits = policy.cast_output(h) @ params["embed"].T  # tied head
-        return logits
+        # tied unembedding as a bf16 MXU matmul with f32 accumulation —
+        # a plain f32 matmul here runs at a fraction of the bf16 rate and
+        # this [b*t, d] @ [d, V] projection is one of the largest in the step
+        logits = jax.lax.dot_general(
+            policy.cast_compute(h), policy.cast_compute(params["embed"]),
+            (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        return policy.cast_output(logits)
 
     def loss(self, params, tokens, *, mesh=None, sequence_parallel=False):
         """Next-token cross entropy (mean over positions)."""

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -38,8 +39,11 @@ def dot_product_attention(
     q: [b, tq, h, d]; k/v: [b, tkv, h, d] → [b, tq, h, d].
     """
     d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scale = scale if scale is not None else float(1.0 / np.sqrt(d))
+    # bf16 inputs feed the MXU; logits accumulate in f32
+    # (preferred_element_type) so the softmax runs at full precision
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if bias is not None:
         logits = logits + bias
     if causal:
@@ -51,7 +55,10 @@ def dot_product_attention(
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :].astype(bool), logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    # cast probabilities back to the value dtype: the PV contraction runs
+    # on the MXU at the bf16 rate with f32 accumulation
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(v.dtype)
 
 
 def multi_head_attention(

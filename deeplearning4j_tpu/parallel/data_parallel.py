@@ -80,8 +80,19 @@ class ParallelWrapper:
 
     def fit(self, data, num_epochs: int = 1):
         """fit(DataSetIterator | DataSet). Batches are sharded over 'data';
-        the jitted step is the network's own — GSPMD handles the rest."""
+        the jitted step is the network's own — GSPMD handles the rest.
+
+        TBPTT and non-SGD-solver configurations are NOT sharded: they
+        delegate wholly to the network's own fit (windowed/solver
+        semantics preserved, single device) rather than silently taking
+        different steps on the mesh."""
         net = self.network
+        if not self._shardable():
+            logger.info("ParallelWrapper: TBPTT/non-SGD config — "
+                        "delegating to the network's own fit path")
+            net.fit(data, num_epochs=num_epochs) if not isinstance(
+                data, DataSet) else net.fit(data)
+            return self
         if isinstance(data, DataSet):
             self._fit_one(data)
             return self
@@ -91,6 +102,16 @@ class ParallelWrapper:
             for ds in data:
                 self._fit_one(ds)
         return self
+
+    def _shardable(self) -> bool:
+        from deeplearning4j_tpu.nn.conf.enums import (
+            BackpropType, OptimizationAlgorithm)
+
+        gc = self.network.conf.global_conf
+        return (gc.optimization_algo
+                == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+                and self.network.conf.backprop_type
+                != BackpropType.TRUNCATED_BPTT)
 
     def _fit_one(self, ds: DataSet):
         net = self.network

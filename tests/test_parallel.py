@@ -363,3 +363,20 @@ class TestUlyssesAttention:
         q = jnp.zeros((1, 16, 6, 8), jnp.float32)  # 6 heads, 8 devices
         with pytest.raises(ValueError, match="not divisible"):
             ulysses_attention(q, q, q, mesh)
+
+
+def test_wrapper_delegates_tbptt_configs():
+    """TBPTT/non-SGD configs must NOT silently shard: the wrapper delegates
+    to the network's own windowed fit path."""
+    from deeplearning4j_tpu.models import char_lstm
+
+    net = char_lstm(vocab_size=8, hidden=6, layers=1, tbptt_length=4).init()
+    wrapper = ParallelWrapper(net)
+    assert not wrapper._shardable()
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 8, (4, 12))
+    x = np.eye(8, dtype=np.float32)[idx]
+    y = np.eye(8, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+    wrapper.fit(DataSet(x, y))
+    # 12 steps / window 4 → 3 TBPTT iterations, not 1 full-BPTT step
+    assert net.iteration_count == 3

@@ -217,7 +217,7 @@ def bench_char_lstm():
     y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, axis=1)]
     x, y = _dev(x, y)
     net = char_lstm(vocab_size=vocab, hidden=256, layers=2,
-                    tbptt_length=50).init()
+                    tbptt_length=50, dtype_policy="bf16").init()
     ds = DataSet(x, y)
     # fit() itself now fuses all TBPTT windows into one scanned program
     sec = _time_loop(lambda: net.fit(ds), steps=5, sync=lambda: net.params)

@@ -61,12 +61,14 @@ def lenet5(lr: float = 1e-3, seed: int = 12345,
 
 def char_lstm(vocab_size: int = 128, hidden: int = 256, layers: int = 2,
               lr: float = 3e-3, tbptt_length: int = 50,
-              seed: int = 12345) -> MultiLayerNetwork:
+              seed: int = 12345,
+              dtype_policy: str = "float32") -> MultiLayerNetwork:
     """GravesLSTM char-RNN (tiny-shakespeare style) with TBPTT —
     BASELINE.md config 4."""
     b = (
         NeuralNetConfiguration.Builder()
         .seed(seed).learning_rate(lr).updater(Updater.ADAM)
+        .dtype_policy(dtype_policy)
         .list()
     )
     n_in = vocab_size

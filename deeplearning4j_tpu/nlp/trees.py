@@ -30,6 +30,7 @@ class Tree:
     label: Optional[int] = None        # e.g. sentiment class 0..C-1
     word: Optional[str] = None         # set on leaves
     children: List["Tree"] = field(default_factory=list)
+    tag: Optional[str] = None          # syntactic category (NP, VP, NN, …)
 
     @property
     def is_leaf(self) -> bool:
@@ -82,7 +83,10 @@ class Tree:
             pos += 1
             label_tok = tokens[pos]
             pos += 1
-            node = Tree(label=int(label_tok) if _is_int(label_tok) else None)
+            if _is_int(label_tok):
+                node = Tree(label=int(label_tok))
+            else:  # syntactic category (NP/VP/NN…): keep as the tag
+                node = Tree(label=None, tag=label_tok)
             while pos < len(tokens) and tokens[pos] != ")":
                 if tokens[pos] == "(":
                     node.children.append(rec())
@@ -203,3 +207,105 @@ def pad_to_bucket(n: int, buckets: Tuple[int, ...] = (8, 16, 32, 64, 128,
         if n <= b:
             return b
     return n
+
+
+# ---------------------------------------------------------------------------
+# head-word finding (text/corpora/treeparser/HeadWordFinder.java:285 —
+# Charniak-style head-percolation rules). Re-expressed as data tables + a
+# best-candidate scan; operates on Tree.tag (syntactic categories from
+# PTB-style parses).
+# ---------------------------------------------------------------------------
+
+# primary (parent, child) head rules — certainty 1
+_HEAD_RULES_1 = frozenset({
+    ("ADJP", "JJ"), ("ADJP", "JJR"), ("ADJP", "JJS"), ("ADVP", "RB"),
+    ("ADVP", "RBB"), ("LST", "LS"), ("NAC", "NNS"), ("NAC", "NN"),
+    ("NAC", "PRP"), ("NAC", "NNPS"), ("NAC", "NNP"), ("NX", "NNS"),
+    ("NX", "NN"), ("NX", "PRP"), ("NX", "NNPS"), ("NX", "NNP"),
+    ("NP", "NNS"), ("NP", "NN"), ("NP", "PRP"), ("NP", "NNPS"),
+    ("NP", "NNP"), ("NP", "POS"), ("NP", "$"), ("PP", "IN"), ("PP", "TO"),
+    ("PP", "RP"), ("PRT", "RP"), ("S", "VP"), ("S1", "S"), ("SBAR", "IN"),
+    ("SBAR", "WHNP"), ("SBARQ", "SQ"), ("SBARQ", "VP"), ("SINV", "VP"),
+    ("SQ", "MD"), ("SQ", "AUX"), ("VP", "VB"), ("VP", "VBZ"), ("VP", "VBP"),
+    ("VP", "VBG"), ("VP", "VBN"), ("VP", "VBD"), ("VP", "AUX"),
+    ("VP", "AUXG"), ("VP", "TO"), ("VP", "MD"), ("WHADJP", "WRB"),
+    ("WHADVP", "WRB"), ("WHNP", "WP"), ("WHNP", "WDT"), ("WHNP", "WP$"),
+    ("WHPP", "IN"), ("WHPP", "TO"),
+})
+
+# secondary rules — certainty 3
+_HEAD_RULES_2 = frozenset({
+    ("ADJP", "VBN"), ("ADJP", "RB"), ("NAC", "NP"), ("NAC", "CD"),
+    ("NAC", "FW"), ("NAC", "ADJP"), ("NAC", "JJ"), ("NX", "NP"),
+    ("NX", "CD"), ("NX", "FW"), ("NX", "ADJP"), ("NX", "JJ"), ("NP", "CD"),
+    ("NP", "ADJP"), ("NP", "JJ"), ("S", "SINV"), ("S", "SBARQ"), ("S", "X"),
+    ("PRT", "RB"), ("PRT", "IN"), ("SBAR", "WHADJP"), ("SBAR", "WHADVP"),
+    ("SBAR", "WHPP"), ("SBARQ", "S"), ("SBARQ", "SINV"), ("SBARQ", "X"),
+    ("SINV", "SBAR"), ("SQ", "VP"),
+})
+
+_TERMINAL_TAGS = frozenset({
+    "AUX", "AUXG", "CC", "CD", "DT", "EX", "FW", "IN", "JJ", "JJR", "JJS",
+    "LS", "MD", "NN", "NNS", "NNP", "NNPS", "PDT", "POS", "PRP", "PRP$",
+    "RB", "RBR", "RBS", "RP", "SYM", "TO", "UH", "VB", "VBD", "VBG", "VBN",
+    "VBP", "VBZ", "WDT", "WP", "WP$", "WRB", "#", "$", ".", ",", ":",
+    "-RRB-", "-LRB-", "``", "''", "EOS",
+})
+
+
+class HeadWordFinder:
+    """Find the lexical head of a parsed subtree.
+
+    Walks from the given node downward, at each level choosing the child
+    with the most certain head claim: primary rule (1) > parent==child
+    category (2) > secondary rule (3) > non-terminal non-PP (5) >
+    non-terminal (6) > anything (7). Equal-certainty ties keep the
+    RIGHTMOST candidate (the ``>=`` comparisons re-fire on later
+    children) except tier 2, which keeps the leftmost — this asymmetry
+    matches the reference's findHead3 scan exactly; do not "fix" the
+    comparisons to strict inequalities.
+    """
+
+    def __init__(self):
+        self._cache: Dict[Tuple[Optional[str], Tuple[Optional[str], ...]],
+                          int] = {}
+
+    def find_head(self, tree: Tree) -> Tree:
+        """Descend to the head LEAF of ``tree``."""
+        cursor = tree
+        if cursor.tag == "TOP" and cursor.children:
+            cursor = cursor.children[0]
+        while cursor.children:
+            cursor = self.find_head_child(cursor)
+        return cursor
+
+    def find_head_child(self, parent: Tree) -> Tree:
+        """The immediate head child of one node."""
+        child_tags = tuple(c.tag for c in parent.children)
+        key = (parent.tag, child_tags)
+        idx = self._cache.get(key)
+        if idx is None:
+            idx = self._head_index(parent.tag, child_tags)
+            self._cache[key] = idx
+        return parent.children[idx]
+
+    @staticmethod
+    def _head_index(parent_tag: Optional[str],
+                    child_tags: Sequence[Optional[str]]) -> int:
+        best, uncertainty = 0, 10
+        for i, tag in enumerate(child_tags):
+            if uncertainty >= 1 and (parent_tag, tag) in _HEAD_RULES_1:
+                best, uncertainty = i, 1
+            elif uncertainty > 2 and parent_tag is not None \
+                    and parent_tag == tag:
+                best, uncertainty = i, 2
+            elif uncertainty >= 3 and (parent_tag, tag) in _HEAD_RULES_2:
+                best, uncertainty = i, 3
+            elif uncertainty >= 5 and tag is not None \
+                    and tag not in _TERMINAL_TAGS and tag != "PP":
+                best, uncertainty = i, 5
+            elif uncertainty >= 6 and tag not in _TERMINAL_TAGS:
+                best, uncertainty = i, 6
+            elif uncertainty >= 7:
+                best, uncertainty = i, 7
+        return best

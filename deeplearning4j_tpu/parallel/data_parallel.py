@@ -88,10 +88,10 @@ class ParallelWrapper:
         different steps on the mesh."""
         net = self.network
         if not self._shardable():
-            logger.info("ParallelWrapper: TBPTT/non-SGD config — "
+            logger.info("ParallelWrapper: non-shardable config (TBPTT/"
+                        "non-SGD/pretrain/SCORE-lr/iterations>1) — "
                         "delegating to the network's own fit path")
-            net.fit(data, num_epochs=num_epochs) if not isinstance(
-                data, DataSet) else net.fit(data)
+            net.fit(data, num_epochs=num_epochs)
             return self
         if isinstance(data, DataSet):
             self._fit_one(data)
@@ -104,14 +104,20 @@ class ParallelWrapper:
         return self
 
     def _shardable(self) -> bool:
+        """Configs whose per-batch semantics the sharded one-step path
+        preserves exactly — the same exclusion list as
+        MultiLayerNetwork.fit_steps (multilayer.py)."""
         from deeplearning4j_tpu.nn.conf.enums import (
-            BackpropType, OptimizationAlgorithm)
+            BackpropType, LearningRatePolicy, OptimizationAlgorithm)
 
-        gc = self.network.conf.global_conf
+        conf = self.network.conf
+        gc = conf.global_conf
         return (gc.optimization_algo
                 == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
-                and self.network.conf.backprop_type
-                != BackpropType.TRUNCATED_BPTT)
+                and conf.backprop_type != BackpropType.TRUNCATED_BPTT
+                and not getattr(conf, "pretrain", False)
+                and gc.lr_policy != LearningRatePolicy.SCORE
+                and max(1, gc.iterations) == 1)
 
     def _fit_one(self, ds: DataSet):
         net = self.network

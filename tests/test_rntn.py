@@ -215,3 +215,49 @@ class TestRecursiveAutoEncoder:
         assert after < before, (before, after)
         # pretraining actually moved the encoder weights
         assert not np.allclose(p0["We"], np.asarray(net.params["0"]["We"]))
+
+
+class TestHeadWordFinder:
+    """HeadWordFinder.java:285 parity: Charniak head-percolation rules over
+    tagged PTB parses."""
+
+    def test_np_head_is_noun(self):
+        from deeplearning4j_tpu.nlp.trees import HeadWordFinder, Tree
+
+        t = Tree.parse("(NP (DT the) (JJ red) (NN dog))")
+        assert t.tag == "NP"
+        head = HeadWordFinder().find_head(t)
+        assert head.word == "dog"
+
+    def test_sentence_head_via_vp(self):
+        from deeplearning4j_tpu.nlp.trees import HeadWordFinder, Tree
+
+        t = Tree.parse(
+            "(S (NP (NNP Alice)) (VP (VBZ eats) (NP (NNS apples))))")
+        finder = HeadWordFinder()
+        # S → VP (primary rule), VP → VBZ (primary rule)
+        assert finder.find_head_child(t).tag == "VP"
+        assert finder.find_head(t).word == "eats"
+
+    def test_top_unwraps_and_cache_stable(self):
+        from deeplearning4j_tpu.nlp.trees import HeadWordFinder, Tree
+
+        t = Tree.parse("(TOP (S (NP (PRP it)) (VP (VBZ works))))")
+        finder = HeadWordFinder()
+        assert finder.find_head(t).word == "works"
+        assert finder.find_head(t).word == "works"  # cached path
+
+    def test_sentiment_trees_untagged_still_parse(self):
+        from deeplearning4j_tpu.nlp.trees import Tree
+
+        t = Tree.parse("(3 (2 the) (3 (2 movie) (2 rocks)))")
+        assert t.label == 3 and t.tag is None
+        assert t.words() == ["the", "movie", "rocks"]
+
+    def test_equal_certainty_tie_keeps_rightmost(self):
+        """Reference findHead3 parity: >= comparisons re-fire, so the
+        RIGHTMOST equal-certainty child wins (except tier 2)."""
+        from deeplearning4j_tpu.nlp.trees import HeadWordFinder, Tree
+
+        t = Tree.parse("(VP (VB go) (VB eat))")
+        assert HeadWordFinder().find_head(t).word == "eat"

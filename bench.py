@@ -443,7 +443,53 @@ def bench_transformer(cpu_baseline=True):
     return result, vs_baseline
 
 
+def _await_backend(timeout_s: float = None):
+    """Initialize the accelerator backend with a hard timeout.
+
+    The tunnel backend's device claim can block INDEFINITELY inside the
+    PJRT C API when a previous client's grant is wedged (observed in
+    round 4: >3 h). A hung bench leaves the driver with no JSON at all;
+    this probe initializes jax in a daemon thread and, on timeout, emits
+    an honest error line and exits so the failure is recorded as data.
+    """
+    import os
+    import threading
+
+    if timeout_s is None:
+        try:
+            timeout_s = float(
+                os.environ.get("BENCH_BACKEND_TIMEOUT_S", "300"))
+        except ValueError:
+            timeout_s = 300.0
+    result = {}
+    ready = threading.Event()
+
+    def probe():
+        try:
+            import jax
+
+            result["devices"] = str(jax.devices())
+        except Exception as e:  # init raised: report, don't hang
+            result["error"] = str(e)[:300]
+        ready.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not ready.wait(timeout_s) or "error" in result:
+        err = result.get(
+            "error", f"backend init did not complete in {timeout_s:.0f}s "
+                     "(wedged device grant?)")
+        _log(f"BACKEND UNAVAILABLE: {err}")
+        print(json.dumps({
+            "metric": "transformer_lm_1024ctx_train_tokens_per_sec_per_chip",
+            "value": None, "unit": "tokens/sec", "vs_baseline": None,
+            "extras": {"error": f"backend unavailable: {err}"},
+        }), flush=True)
+        os._exit(0)
+    _log(f"backend up: {result['devices']}")
+
+
 def main() -> None:
+    _await_backend()
     extras = {"peak_tflops_bf16_per_chip": PEAK_TFLOPS_BF16,
               "chip": "TPU v5e (1 chip)"}
     for name, fn in [("gemm", bench_gemm), ("mnist_mlp", bench_mlp),

@@ -207,3 +207,45 @@ def test_rnn_time_step_jitted_cached():
     fn = net._rnn_step_fn
     net.rnn_time_step(x[:, 0])
     assert net._rnn_step_fn is fn
+
+
+def test_interleaved_fit_fitsteps_output_score():
+    """Donated-buffer paths interleave safely: fit, fit_steps, output,
+    score, evaluate all reuse the live param tree without touching
+    deleted (donated) arrays."""
+    from deeplearning4j_tpu.models import mnist_mlp
+
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 784), np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+    ds = DataSet(x, y)
+    net = mnist_mlp(hidden=16).init()
+    net.fit(ds)
+    out1 = np.asarray(net.output(x))
+    net.fit_steps(ds, 3)
+    s1 = net.score(ds)
+    net.fit(ds)
+    net.fit_steps(ds, 2)
+    out2 = np.asarray(net.output(x))
+    s2 = net.score(ds)
+    assert np.isfinite(out1).all() and np.isfinite(out2).all()
+    assert np.isfinite(s1) and np.isfinite(s2)
+    assert net.iteration_count == 7
+    acc = net.evaluate(ds).accuracy()
+    assert 0.0 <= acc <= 1.0
+
+
+def test_graph_interleaved_fit_fitsteps_output():
+    from deeplearning4j_tpu.models import resnet18
+
+    rng = np.random.default_rng(0)
+    x = rng.random((4, 32, 32, 3), np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    ds = DataSet(x, y)
+    net = resnet18(num_classes=10).init()
+    net.fit(ds)
+    net.fit_steps(ds, 2)
+    out = np.asarray(net.output(x)[0])
+    net.fit(ds)
+    assert np.isfinite(out).all()
+    assert net.iteration_count == 4

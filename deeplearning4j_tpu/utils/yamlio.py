@@ -82,6 +82,10 @@ def _emit(obj: Any, indent: int, lines: List[str]) -> None:
             elif isinstance(item, (list, tuple)) and len(item):
                 lines.append(f"{pad}-")
                 _emit(list(item), indent + 1, lines)
+            elif isinstance(item, dict):
+                lines.append(f"{pad}- {{}}")
+            elif isinstance(item, (list, tuple)):
+                lines.append(f"{pad}- []")
             else:
                 lines.append(f"{pad}- {_scalar(item)}")
     else:

@@ -308,3 +308,10 @@ class TestYamlEdgeCases:
         doc = {"name": "nan", "other": "Infinity", "real": 1.5}
         back = yamlio.load(yamlio.dump(doc))
         assert back == doc and isinstance(back["name"], str)
+
+    def test_empty_collections_in_sequences(self):
+        from deeplearning4j_tpu.utils import yamlio
+
+        doc = {"xs": [[], {}, [1], {"a": 1}, "[]"],
+               "empty_list": [], "empty_map": {}}
+        assert yamlio.load(yamlio.dump(doc)) == doc

@@ -315,3 +315,56 @@ class TestYamlEdgeCases:
         doc = {"xs": [[], {}, [1], {"a": 1}, "[]"],
                "empty_list": [], "empty_map": {}}
         assert yamlio.load(yamlio.dump(doc)) == doc
+
+
+class TestReferenceJsonFullLayerMatrix:
+    """Every Jackson wrapper tag in Layer.java:44-59 translates."""
+
+    def test_all_layer_tags_translate(self):
+        docs = {
+            "autoEncoder": {"nIn": 8, "nOut": 4},
+            "convolution": {"nIn": 1, "nOut": 4, "kernelSize": [3, 3],
+                            "stride": [1, 1], "padding": [0, 0]},
+            "imageLSTM": {"nIn": 8, "nOut": 6},
+            "gravesLSTM": {"nIn": 8, "nOut": 6},
+            "gravesBidirectionalLSTM": {"nIn": 8, "nOut": 6},
+            "gru": {"nIn": 8, "nOut": 6},
+            "output": {"nIn": 8, "nOut": 3, "lossFunction": "MCXENT"},
+            "rnnoutput": {"nIn": 8, "nOut": 3, "lossFunction": "MCXENT"},
+            "RBM": {"nIn": 8, "nOut": 4, "hiddenUnit": "BINARY",
+                    "visibleUnit": "BINARY", "k": 1},
+            "dense": {"nIn": 8, "nOut": 4},
+            "recursiveAutoEncoder": {"nIn": 8, "nOut": 8},
+            "subsampling": {"poolingType": "AVG", "kernelSize": [2, 2],
+                            "stride": [2, 2], "padding": [0, 0]},
+            "batchNormalization": {"nIn": 8, "nOut": 8, "decay": 0.9,
+                                   "eps": 1e-5},
+            "localResponseNormalization": {"n": 5.0, "alpha": 1e-4,
+                                           "beta": 0.75},
+            "embedding": {"nIn": 20, "nOut": 8},
+            "activation": {"activationFunction": "relu"},
+        }
+        from deeplearning4j_tpu.nn.conf.compat import _convert_layer
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.enums import HiddenUnit, PoolingType
+
+        expected = {
+            "autoEncoder": L.AutoEncoder, "convolution": L.ConvolutionLayer,
+            "imageLSTM": L.ImageLSTM, "gravesLSTM": L.GravesLSTM,
+            "gravesBidirectionalLSTM": L.GravesBidirectionalLSTM,
+            "gru": L.GRU, "output": L.OutputLayer,
+            "rnnoutput": L.RnnOutputLayer, "RBM": L.RBM,
+            "dense": L.DenseLayer,
+            "recursiveAutoEncoder": L.RecursiveAutoEncoder,
+            "subsampling": L.SubsamplingLayer,
+            "batchNormalization": L.BatchNormalization,
+            "localResponseNormalization": L.LocalResponseNormalization,
+            "embedding": L.EmbeddingLayer, "activation": L.ActivationLayer,
+        }
+        for tag, fields in docs.items():
+            layer = _convert_layer({tag: fields})
+            assert type(layer) is expected[tag], tag
+        rbm = _convert_layer({"RBM": docs["RBM"]})
+        assert rbm.hidden_unit == HiddenUnit.BINARY
+        sub = _convert_layer({"subsampling": docs["subsampling"]})
+        assert sub.pooling_type == PoolingType.AVG

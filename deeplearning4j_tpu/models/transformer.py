@@ -36,9 +36,17 @@ from deeplearning4j_tpu.pallas.flash_attention import (
 
 
 def _layernorm(x, g, b, eps=1e-5):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * jax.lax.rsqrt(var + eps) * g + b
+    # statistics in >=f32, but the result stays in x's dtype: multiplying
+    # by the f32 g/b params directly would promote the whole residual
+    # stream to f32 and silently turn every downstream matmul into an
+    # f32 MXU op (measured 11.9% -> 14.0% MFU on the t=1024 bench config;
+    # the rest of the gap is the materialized [b,h,t,t] score matrix)
+    st = jnp.promote_types(x.dtype, jnp.float32)
+    xs = x.astype(st)
+    mean = jnp.mean(xs, axis=-1, keepdims=True)
+    var = jnp.var(xs, axis=-1, keepdims=True)
+    y = (xs - mean) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(st) + b.astype(st)).astype(x.dtype)
 
 
 class TransformerLM:
@@ -139,8 +147,9 @@ class TransformerLM:
             h = h + o.reshape(b, t, -1) @ policy.cast_compute(blk["attn"]["wo"])
             x = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
             x = jax.nn.gelu(x @ policy.cast_compute(blk["mlp"]["w1"])
-                            + blk["mlp"]["b1"])
-            h = h + x @ policy.cast_compute(blk["mlp"]["w2"]) + blk["mlp"]["b2"]
+                            + policy.cast_compute(blk["mlp"]["b1"]))
+            h = (h + x @ policy.cast_compute(blk["mlp"]["w2"])
+                 + policy.cast_compute(blk["mlp"]["b2"]))
         h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
         # tied unembedding as a bf16 MXU matmul with f32 accumulation —
         # a plain f32 matmul here runs at a fraction of the bf16 rate and

@@ -328,12 +328,13 @@ def bench_infeed():
             "batch": batch, "n_batches": n_batches}
 
 
-def _transformer(t, vocab=8192, d=512, layers=8, heads=8, attn="auto"):
+def _transformer(t, vocab=8192, d=512, layers=8, heads=8, attn="auto",
+                 remat=False):
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
     return TransformerLM(vocab_size=vocab, d_model=d, num_heads=heads,
                          num_layers=layers, max_len=t, seed=0,
-                         dtype_policy="bf16", attn_impl=attn)
+                         dtype_policy="bf16", attn_impl=attn, remat=remat)
 
 
 def _transformer_flops_per_token(lm, t):
@@ -344,10 +345,11 @@ def _transformer_flops_per_token(lm, t):
     return 6 * n_params_matmul + 12 * lm.num_layers * lm.d_model * t // 2
 
 
-def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto"):
+def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
+                           remat=False):
     import jax.numpy as jnp
 
-    lm = _transformer(t, attn=attn).init()
+    lm = _transformer(t, attn=attn, remat=remat).init()
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
     _sync(tokens)
@@ -375,7 +377,7 @@ def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto"):
         "fused_tokens_per_sec": (
             0.0 if sec_fused == float("inf")
             else round(batch * t / sec_fused, 1)),
-        "batch": batch, "seq_len": t,
+        "batch": batch, "seq_len": t, "remat": remat,
         "attn_impl": lm._attn_impl(t),
         "model_tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
     }, tps, lm
@@ -390,10 +392,14 @@ def bench_transformer(cpu_baseline=True):
     best_tps, best_cfg = 0.0, None
     for batch in (16, 32, 64):
         try:
-            cfg, tps, _ = _bench_transformer_cfg(batch, 1024)
+            # b64's f32 logit temps overflow HBM without remat; the remat
+            # column also records what the recompute tax costs at this size
+            remat = batch >= 64
+            cfg, tps, _ = _bench_transformer_cfg(batch, 1024, remat=remat)
             sweep[str(batch)] = cfg
             _log(f"transformer b{batch} t1024: {cfg['tokens_per_sec']:,.0f} "
-                 f"tok/s ({cfg['mfu_pct']:.1f}% MFU, {cfg['attn_impl']})")
+                 f"tok/s ({cfg['mfu_pct']:.1f}% MFU, {cfg['attn_impl']}"
+                 f"{', remat' if remat else ''})")
             if tps > best_tps:
                 best_tps, best_cfg = tps, cfg
         except Exception as e:

@@ -53,12 +53,18 @@ class TransformerLM:
     def __init__(self, vocab_size: int, d_model: int = 256, num_heads: int = 8,
                  num_layers: int = 4, d_ff: Optional[int] = None,
                  max_len: int = 512, lr: float = 3e-4, seed: int = 0,
-                 dtype_policy: str = "float32", attn_impl: str = "auto"):
+                 dtype_policy: str = "float32", attn_impl: str = "auto",
+                 remat: bool = False):
         assert d_model % num_heads == 0
         # "auto": Pallas flash kernel when a TPU backend is attached and
         # head_dim maps onto lane tiles; "xla" / "flash" force a path
         assert attn_impl in ("auto", "xla", "flash")
         self.attn_impl = attn_impl
+        # remat: recompute each block's activations in the backward pass
+        # (jax.checkpoint) instead of keeping them live across the whole
+        # step — trades ~1/3 more FLOPs for O(sqrt) activation memory, the
+        # standard TPU HBM lever for large batch x seq products
+        self.remat = remat
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.num_heads = num_heads
@@ -129,7 +135,7 @@ class TransformerLM:
         h = jnp.take(params["embed"], tokens, axis=0)
         h = h + params["pos"][:t][None]
         h = policy.cast_compute(h)
-        for blk in params["blocks"]:
+        def block_fn(blk, h):
             x = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
             q = (x @ policy.cast_compute(blk["attn"]["wq"])).reshape(
                 b, t, self.num_heads, -1)
@@ -148,8 +154,13 @@ class TransformerLM:
             x = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
             x = jax.nn.gelu(x @ policy.cast_compute(blk["mlp"]["w1"])
                             + policy.cast_compute(blk["mlp"]["b1"]))
-            h = (h + x @ policy.cast_compute(blk["mlp"]["w2"])
-                 + policy.cast_compute(blk["mlp"]["b2"]))
+            return (h + x @ policy.cast_compute(blk["mlp"]["w2"])
+                    + policy.cast_compute(blk["mlp"]["b2"]))
+
+        if self.remat:
+            block_fn = jax.checkpoint(block_fn)
+        for blk in params["blocks"]:
+            h = block_fn(blk, h)
         h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
         # tied unembedding as a bf16 MXU matmul with f32 accumulation —
         # a plain f32 matmul here runs at a fraction of the bf16 rate and

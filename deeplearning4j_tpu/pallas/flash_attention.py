@@ -213,14 +213,18 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     tkv = k.shape[1]
     block_k = min(block_k, -(-tkv // 128) * 128)
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(out.astype(jnp.float32) * dof, axis=-1)  # [b, tq, h]
+    # matmul operands stay in the INPUT dtype (bf16 under the mixed
+    # policy) with f32 accumulation via preferred_element_type — casting
+    # them to f32 would run every backward einsum at the f32 MXU rate.
+    # Softmax math (p, ds, delta) stays f32.
+    mm = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
+    dof = do.astype(q.dtype)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)                                 # [b, tq, h]
     delta = delta.transpose(0, 2, 1)                         # [b, h, tq]
 
-    pad = (-tkv) % block_k
-    kp = _pad_time(k.astype(jnp.float32), block_k)
-    vp = _pad_time(v.astype(jnp.float32), block_k)
+    kp = _pad_time(k, block_k)
+    vp = _pad_time(v, block_k)
     n_blocks = kp.shape[1] // block_k
     # [n_blocks, b, block_k, h, d]
     kb = kp.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
@@ -231,18 +235,19 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     def step(dq, blk):
         j, kj, vj = blk
         k_pos = k_offset + j * block_k + jnp.arange(block_k)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj) * scale_val
+        s = mm("bqhd,bkhd->bhqk", q, kj) * scale_val
         valid = (k_pos < k_offset + tkv)[None, :]
         if causal:
             valid = valid & (q_pos[:, None] >= k_pos[None, :])
         s = jnp.where(valid[None, None], s, MASK_VALUE)
-        p = jnp.exp(s - lse[..., None])          # [b, h, tq, block_k]
+        p = jnp.exp(s - lse[..., None])          # [b, h, tq, block_k] f32
         p = jnp.where(valid[None, None], p, 0.0)
-        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vj)
+        dv_j = mm("bhqk,bqhd->bkhd", p.astype(q.dtype), dof)
+        dp = mm("bqhd,bkhd->bhqk", dof, vj)
         ds = p * (dp - delta[..., None]) * scale_val
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kj)
-        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        ds_c = ds.astype(q.dtype)
+        dq = dq + mm("bhqk,bkhd->bqhd", ds_c, kj)
+        dk_j = mm("bhqk,bqhd->bkhd", ds_c, q)
         return dq, (dk_j, dv_j)
 
     dq0 = jnp.zeros((b, tq, h, d), jnp.float32)

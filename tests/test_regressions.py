@@ -251,7 +251,8 @@ def test_graph_interleaved_fit_fitsteps_output():
     assert net.iteration_count == 4
 
 
-def test_transformer_bf16_policy_no_f32_matmuls():
+@pytest.mark.parametrize("remat", [False, True])
+def test_transformer_bf16_policy_no_f32_matmuls(remat):
     """Under the bf16 policy the residual stream must stay in the compute
     dtype end to end: the f32 layernorm g/b (and MLP biases) used to
     promote it to f32, silently turning every downstream matmul into an
@@ -265,23 +266,34 @@ def test_transformer_bf16_policy_no_f32_matmuls():
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
     lm = TransformerLM(vocab_size=64, d_model=32, num_heads=4, num_layers=2,
-                       max_len=16, dtype_policy="bf16", seed=0).init()
+                       max_len=16, dtype_policy="bf16", seed=0,
+                       remat=remat).init()
     tok = jnp.asarray(
         np.random.default_rng(0).integers(0, 64, (2, 16)), jnp.int32)
     jaxpr = jax.make_jaxpr(lambda p, t: lm.loss(p, t))(lm.params, tok)
 
     offenders = []
+    seen_dots = [0]
 
     def scan(eqns):
         for e in eqns:
             if e.primitive.name == "dot_general":
+                seen_dots[0] += 1
                 if any(v.aval.dtype == jnp.float32 for v in e.invars):
                     offenders.append(e)
             for sub in e.params.values():
+                # closed jaxprs (pjit/scan) carry .jaxpr; remat2 carries
+                # an OPEN core.Jaxpr with .eqns directly — missing it
+                # would skip every matmul inside a rematted block
                 if hasattr(sub, "jaxpr"):
                     scan(sub.jaxpr.eqns)
+                elif hasattr(sub, "eqns"):
+                    scan(sub.eqns)
 
     scan(jaxpr.jaxpr.eqns)
+    # guard against the scan going vacuous (e.g. a new wrapper primitive
+    # hiding the block body): 2 layers x 6 matmuls + unembed must be seen
+    assert seen_dots[0] >= 13, f"scan only saw {seen_dots[0]} dot_generals"
     assert not offenders, (
         f"{len(offenders)} f32-operand dot_general(s) under bf16 policy; "
         "an f32 operand leaked into the residual stream")

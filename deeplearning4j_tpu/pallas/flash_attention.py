@@ -104,6 +104,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = m_ref[...] + jnp.log(safe_l)
 
 
+def _round128(t: int) -> int:
+    """Round up to the TPU lane-tile multiple used for block clamping."""
+    return -(-t // 128) * 128
+
+
+def _flat_heads(x):
+    """[b, t, h, d] -> [b*h, t, d] (the kernels' batch-of-heads layout)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
 def _pad_time(x, block):
     """Zero-pad axis 1 (time) up to a multiple of ``block``."""
     pad = (-x.shape[1]) % block
@@ -145,17 +156,13 @@ def flash_attention_fwd(
         raise ValueError(
             f"flash_attention(causal=True) requires tq == tkv (got "
             f"tq={tq}, tkv={tkv}); self-attention positions must align")
-    block_q = min(block_q, -(-tq // 128) * 128)
-    block_k = min(block_k, -(-tkv // 128) * 128)
+    block_q = min(block_q, _round128(tq))
+    block_k = min(block_k, _round128(tkv))
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
 
-    # [b, t, h, d] -> [b*h, t, d]
-    def _flat(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    qf = _pad_time(_flat(q), block_q)
-    kf = _pad_time(_flat(k), block_k)
-    vf = _pad_time(_flat(v), block_k)
+    qf = _pad_time(_flat_heads(q), block_q)
+    kf = _pad_time(_flat_heads(k), block_k)
+    vf = _pad_time(_flat_heads(v), block_k)
     tq_p, tkv_p = qf.shape[1], kf.shape[1]
     n_q, n_k = tq_p // block_q, tkv_p // block_k
 
@@ -211,7 +218,7 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     """
     b, tq, h, d = q.shape
     tkv = k.shape[1]
-    block_k = min(block_k, -(-tkv // 128) * 128)
+    block_k = min(block_k, _round128(tkv))
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
     # matmul operands stay in the INPUT dtype (bf16 under the mixed
     # policy) with f32 accumulation via preferred_element_type — casting
@@ -258,6 +265,212 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     return dq, dk, dv
 
 
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+              qi, ki, scale, causal, block_q, block_k, q_len, kv_len):
+    """Shared backward tile math, kv-major ([block_k, block_q]) so the
+    per-query lse/delta broadcast along lanes — no sublane transposes.
+    Returns ``(p, ds)`` in f32; the score tile never leaves VMEM."""
+    q = q_ref[0]            # [block_q, d]
+    k = k_ref[0]            # [block_k, d]
+    v = v_ref[0]
+    do = do_ref[0]          # [block_q, d]
+    lse = lse_ref[0]        # [block_q] f32 (lanes)
+    delta = delta_ref[0]    # [block_q] f32
+    s = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1)
+    k_pos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+    valid = (q_pos < q_len) & (k_pos < kv_len)
+    if causal:
+        valid &= q_pos >= k_pos
+    s = jnp.where(valid, s, MASK_VALUE)
+    # masked entries: exp(MASK - lse) == 0 for any finite lse (padded
+    # query rows pad lse with 0), so no post-exp zeroing is needed
+    p = jnp.exp(s - lse[None, :])            # [block_k, block_q] f32
+    dp = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[None, :]) * scale
+    return p, ds
+
+
+def _when_block_visible(causal, qi, ki, block_q, block_k, fn):
+    """Run ``fn`` unless causal masking makes the whole tile dead
+    (query block strictly above the diagonal)."""
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            fn()
+    else:
+        fn()
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                     block_q, block_k, n_q, q_len, kv_len):
+    """dk/dv for one key block, scanning query blocks."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        p, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          qi=qi, ki=ki, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_len=q_len, kv_len=kv_len)
+        q, do = q_ref[0], do_ref[0]
+        dv_acc[...] += lax.dot_general(
+            p.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _when_block_visible(causal, qi, ki, block_q, block_k, _compute)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                   n_k, q_len, kv_len):
+    """dq for one query block, scanning key blocks (kv-major tiles)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        _, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          qi=qi, ki=ki, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_len=q_len, kv_len=kv_len)
+        k = k_ref[0]
+        # contract over the key dim (sublanes): [bk, bq]^T x [bk, d]
+        dq_acc[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _when_block_visible(causal, qi, ki, block_q, block_k, _compute)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
+                          scale: Optional[float] = None, block_q: int = 512,
+                          block_k: int = 512,
+                          interpret: Optional[bool] = None):
+    """Pallas flash backward: the score/probability tiles stay in VMEM
+    (two kernels: dk/dv over key blocks, dq over query blocks), unlike
+    :func:`flash_backward` whose XLA scan round-trips O(t·block) f32
+    temps through HBM. Self-attention spans only (positions 0..t); the
+    ring path keeps the scan version for its traced offsets.
+
+    Returns (dq, dk, dv) as float32 in the input layouts.
+    """
+    if interpret is None:
+        interpret = flash_default_interpret()
+    b, tq, h, d = q.shape
+    tkv = k.shape[1]
+    block_q = min(block_q, _round128(tq))
+    block_k = min(block_k, _round128(tkv))
+    scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
+
+    qf = _pad_time(_flat_heads(q), block_q)
+    dof = _pad_time(_flat_heads(do.astype(q.dtype)), block_q)
+    kf = _pad_time(_flat_heads(k), block_k)
+    vf = _pad_time(_flat_heads(v), block_k)
+    tq_p, tkv_p = qf.shape[1], kf.shape[1]
+    n_q, n_k = tq_p // block_q, tkv_p // block_k
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)                       # [b, tq, h]
+    delta = delta.transpose(0, 2, 1).reshape(b * h, tq)
+    lse_f = lse.reshape(b * h, tq)
+    pad_q = tq_p - tq
+    if pad_q:
+        # padded q rows: lse=0 pairs with the MASK_VALUE scores so
+        # exp(MASK - 0) == 0 — they contribute nothing
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+        lse_f = jnp.pad(lse_f, ((0, 0), (0, pad_q)))
+
+    common = dict(scale=scale_val, causal=causal,
+                  block_q=block_q, block_k=block_k,
+                  q_len=tq, kv_len=tkv)
+
+    def specs(q_idx, k_idx):
+        """Input specs for a (bh, i, j) grid; q/do/lse/delta blocks follow
+        ``q_idx(i, j)``, k/v blocks follow ``k_idx(i, j)``."""
+        return [
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j: (bh, q_idx(i, j), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (bh, k_idx(i, j), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (bh, k_idx(i, j), 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j: (bh, q_idx(i, j), 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, q_idx(i, j))),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, q_idx(i, j))),
+        ]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, n_q=n_q, **common),
+        grid=(b * h, n_k, n_q),
+        in_specs=specs(q_idx=lambda i, j: j, k_idx=lambda i, j: i),
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tkv_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tkv_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_f, delta)
+
+    (dq,) = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, n_k=n_k, **common),
+        grid=(b * h, n_q, n_k),
+        in_specs=specs(q_idx=lambda i, j: i, k_idx=lambda i, j: j),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_f, delta)
+
+    def _unflat(x, t):
+        return x[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return _unflat(dq, tq), _unflat(dk, tkv), _unflat(dv, tkv)
+
+
 class _FlashConfig:
     """Hashable static config for the custom_vjp nondiff argument."""
 
@@ -299,9 +512,9 @@ def _flash_fwd_rule(cfg, q, k, v):
 
 def _flash_bwd_rule(cfg, res, do):
     q, k, v, out, lse = res
-    dq, dk, dv = flash_backward(
+    dq, dk, dv = flash_backward_pallas(
         q, k, v, out, lse, do, causal=cfg.causal, scale=cfg.scale,
-        block_k=cfg.block_k)
+        block_q=cfg.block_q, block_k=cfg.block_k, interpret=cfg.interpret)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import dtypes as dtypes_mod
@@ -127,6 +128,40 @@ class TransformerLM:
             return "flash"
         return "xla"
 
+    def _block(self, blk, h, *, mesh: Optional[Mesh] = None,
+               sequence_parallel: bool = False, attention=None):
+        """One pre-norm block on ``h`` [b, t, D]. Returns ``(h, k, v)``
+        with k/v in [b, t, H, Dh] — ``forward`` discards them (XLA DCE),
+        the KV-cache prefill keeps them. ``attention(q, k, v) -> o``
+        overrides the causal self-attention core (the KV-cache decode
+        attends against the cache instead) while sharing every other
+        line of block math."""
+        policy = self.policy
+        b, t = h.shape[0], h.shape[1]
+        x = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+        q = (x @ policy.cast_compute(blk["attn"]["wq"])).reshape(
+            b, t, self.num_heads, -1)
+        k = (x @ policy.cast_compute(blk["attn"]["wk"])).reshape(
+            b, t, self.num_heads, -1)
+        v = (x @ policy.cast_compute(blk["attn"]["wv"])).reshape(
+            b, t, self.num_heads, -1)
+        if attention is not None:
+            o = attention(q, k, v)
+        elif sequence_parallel and mesh is not None:
+            o = ring_attention(q, k, v, mesh, causal=True,
+                               impl=self._attn_impl(t))
+        elif self._attn_impl(t) == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = dot_product_attention(q, k, v, causal=True)
+        h = h + o.reshape(b, t, -1) @ policy.cast_compute(blk["attn"]["wo"])
+        x = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+        x = jax.nn.gelu(x @ policy.cast_compute(blk["mlp"]["w1"])
+                        + policy.cast_compute(blk["mlp"]["b1"]))
+        h = (h + x @ policy.cast_compute(blk["mlp"]["w2"])
+             + policy.cast_compute(blk["mlp"]["b2"]))
+        return h, k, v
+
     def forward(self, params, tokens, *, mesh: Optional[Mesh] = None,
                 sequence_parallel: bool = False):
         """tokens: [b, t] int32 → logits [b, t, V]."""
@@ -135,27 +170,10 @@ class TransformerLM:
         h = jnp.take(params["embed"], tokens, axis=0)
         h = h + params["pos"][:t][None]
         h = policy.cast_compute(h)
+
         def block_fn(blk, h):
-            x = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
-            q = (x @ policy.cast_compute(blk["attn"]["wq"])).reshape(
-                b, t, self.num_heads, -1)
-            k = (x @ policy.cast_compute(blk["attn"]["wk"])).reshape(
-                b, t, self.num_heads, -1)
-            v = (x @ policy.cast_compute(blk["attn"]["wv"])).reshape(
-                b, t, self.num_heads, -1)
-            if sequence_parallel and mesh is not None:
-                o = ring_attention(q, k, v, mesh, causal=True,
-                                   impl=self._attn_impl(t))
-            elif self._attn_impl(t) == "flash":
-                o = flash_attention(q, k, v, causal=True)
-            else:
-                o = dot_product_attention(q, k, v, causal=True)
-            h = h + o.reshape(b, t, -1) @ policy.cast_compute(blk["attn"]["wo"])
-            x = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
-            x = jax.nn.gelu(x @ policy.cast_compute(blk["mlp"]["w1"])
-                            + policy.cast_compute(blk["mlp"]["b1"]))
-            return (h + x @ policy.cast_compute(blk["mlp"]["w2"])
-                    + policy.cast_compute(blk["mlp"]["b2"]))
+            return self._block(blk, h, mesh=mesh,
+                               sequence_parallel=sequence_parallel)[0]
 
         if self.remat:
             block_fn = jax.checkpoint(block_fn)
@@ -264,6 +282,130 @@ class TransformerLM:
     @functools.cached_property
     def _default_step(self):
         return self.make_train_step()
+
+    # ------------------------------------------------------------------
+    # autoregressive decoding (KV cache)
+    # ------------------------------------------------------------------
+    def make_generate(self, prompt_len: int, max_new_tokens: int, *,
+                      temperature: float = 0.0, top_k: Optional[int] = None):
+        """Build a jitted ``gen(params, prompt, key) -> [b, total]`` decoder.
+
+        The stateful-inference analogue of the reference's ``rnnTimeStep``
+        (MultiLayerNetwork.java:1208 stateMap carry), TPU-first: the prompt
+        prefills the KV cache with ONE batched forward (all positions in
+        parallel through the shared block math), then a decode-only
+        ``lax.scan`` emits one token per step against the static-shape
+        cache (``lax.dynamic_update_slice``) — a single XLA program, no
+        per-token dispatch. ``temperature=0`` decodes greedily; otherwise
+        samples from ``softmax(logits/temperature)`` filtered to ``top_k``.
+        """
+        total = prompt_len + max_new_tokens
+        if prompt_len < 1:
+            raise ValueError("prompt_len must be >= 1")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {total} exceeds "
+                f"max_len={self.max_len}")
+        if top_k is not None and not 1 <= top_k <= self.vocab_size:
+            raise ValueError(
+                f"top_k={top_k} must be in [1, vocab_size={self.vocab_size}]")
+        if temperature < 0.0:
+            raise ValueError(f"temperature={temperature} must be >= 0")
+        policy = self.policy
+        H, Dh = self.num_heads, self.d_model // self.num_heads
+
+        def unembed_logits(params, h_last):
+            hf = _layernorm(h_last, params["ln_f"]["g"], params["ln_f"]["b"])
+            return lax.dot_general(
+                policy.cast_compute(hf), policy.cast_compute(params["embed"]),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [b, V]
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+            scaled = logits / temperature
+            if top_k is not None:
+                kth = lax.top_k(scaled, top_k)[0][:, -1]
+                scaled = jnp.where(scaled >= kth[:, None], scaled, -jnp.inf)
+            key, sub = jax.random.split(key)
+            return jax.random.categorical(sub, scaled, axis=-1).astype(
+                jnp.int32), key
+
+        def gen(params, prompt, key):
+            b = prompt.shape[0]
+            cdt = policy.compute_dtype
+
+            # ---- prefill: one parallel forward over the prompt
+            h = jnp.take(params["embed"], prompt, axis=0)
+            h = h + params["pos"][:prompt_len][None]
+            h = policy.cast_compute(h)
+            cache = []
+            pad_t = ((0, 0), (0, max_new_tokens), (0, 0), (0, 0))
+            for blk in params["blocks"]:
+                h, kk, vv = self._block(blk, h)
+                cache.append({"k": jnp.pad(kk.astype(cdt), pad_t),
+                              "v": jnp.pad(vv.astype(cdt), pad_t)})
+            first, key = sample(unembed_logits(params, h[:, -1]), key)
+
+            # ---- decode: one token per scan step against the cache,
+            # sharing _block's math; only the attention core differs
+            def step(carry, t):
+                cache, tok, key = carry
+                h = jnp.take(params["embed"], tok, axis=0) + params["pos"][t]
+                h = policy.cast_compute(h)[:, None, :]          # [b, 1, D]
+                live = (jnp.arange(total) <= t)[None, :]        # [1, total]
+                new_cache = []
+
+                def cached_attention(c):
+                    def attn(q, kk, vv):
+                        ck = lax.dynamic_update_slice(
+                            c["k"], kk.astype(cdt), (0, t, 0, 0))
+                        cv = lax.dynamic_update_slice(
+                            c["v"], vv.astype(cdt), (0, t, 0, 0))
+                        new_cache.append({"k": ck, "v": cv})
+                        return dot_product_attention(
+                            q, ck, cv,
+                            mask=jnp.broadcast_to(live, (b, total)))
+                    return attn
+
+                for blk, c in zip(params["blocks"], cache):
+                    h, _, _ = self._block(blk, h,
+                                          attention=cached_attention(c))
+                nxt, key = sample(unembed_logits(params, h[:, 0]), key)
+                return (new_cache, nxt, key), nxt
+
+            # steps consume generated tokens at positions p .. total-2,
+            # each emitting the NEXT token; `first` is position p itself
+            (_, _, _), rest = lax.scan(
+                step, (cache, first, key),
+                jnp.arange(prompt_len, total - 1))
+            gen_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return jnp.concatenate(
+                [prompt, gen_tokens.astype(prompt.dtype)], axis=1)
+
+        return jax.jit(gen)
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seed: int = 0):
+        """Decode ``max_new_tokens`` past ``prompt`` ([b, t] int32).
+        Compiles one program per (shape, sampling) signature and caches it."""
+        if self.params is None:
+            self.init()
+        prompt = jnp.asarray(prompt, jnp.int32)
+        sig = (prompt.shape, max_new_tokens, temperature, top_k)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        fn = cache.get(sig)
+        if fn is None:
+            fn = cache[sig] = self.make_generate(
+                prompt.shape[1], max_new_tokens,
+                temperature=temperature, top_k=top_k)
+        return fn(self.params, prompt, jax.random.PRNGKey(seed))
 
     # ------------------------------------------------------------------
     # tensor-parallel sharding specs (Megatron split)

@@ -218,3 +218,72 @@ class TestTransformerRemat:
         for _ in range(40):
             last = lm.fit_batch(tok, train_step=step)
         assert last < first * 0.6
+
+
+class TestTransformerGenerate:
+    @pytest.mark.parametrize("policy", ["float32", "bf16"])
+    def test_greedy_matches_full_forward_rerun(self, policy):
+        """KV-cache decoding must reproduce the naive decode that re-runs
+        the full forward per token (the cache is an optimization, not a
+        semantic change) — under BOTH dtype policies: the decode step
+        shares _block + dot_product_attention with the forward, so
+        accumulation dtypes match."""
+        import numpy as np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        lm = TransformerLM(vocab_size=48, d_model=32, num_heads=4,
+                           num_layers=2, max_len=24, seed=11,
+                           dtype_policy=policy).init()
+        prompt = jnp.asarray(
+            np.random.default_rng(5).integers(0, 48, (2, 6)), jnp.int32)
+        out = lm.generate(prompt, max_new_tokens=8)
+        assert out.shape == (2, 14)
+
+        seq = prompt
+        for _ in range(8):
+            logits = lm.forward(lm.params, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_sampling_paths(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        lm = TransformerLM(vocab_size=32, d_model=32, num_heads=4,
+                           num_layers=1, max_len=16, seed=3,
+                           dtype_policy="bf16").init()
+        prompt = jnp.asarray(
+            np.random.default_rng(6).integers(0, 32, (3, 4)), jnp.int32)
+        out = lm.generate(prompt, max_new_tokens=5, temperature=0.8,
+                          top_k=8, seed=7)
+        assert out.shape == (3, 9)
+        assert int(out.max()) < 32 and int(out.min()) >= 0
+        # prompt is preserved verbatim
+        np.testing.assert_array_equal(np.asarray(out[:, :4]),
+                                      np.asarray(prompt))
+        # same seed reproduces, different seed may differ
+        out2 = lm.generate(prompt, max_new_tokens=5, temperature=0.8,
+                           top_k=8, seed=7)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_argument_guards(self):
+        import pytest as _pytest
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        lm = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                           num_layers=1, max_len=8, seed=0).init()
+        with _pytest.raises(ValueError, match="max_len"):
+            lm.make_generate(6, 4)
+        with _pytest.raises(ValueError, match="prompt_len"):
+            lm.make_generate(0, 4)
+        with _pytest.raises(ValueError, match="max_new_tokens"):
+            lm.make_generate(4, 0)
+        with _pytest.raises(ValueError, match="top_k"):
+            lm.make_generate(2, 2, temperature=1.0, top_k=17)
+        with _pytest.raises(ValueError, match="top_k"):
+            lm.make_generate(2, 2, temperature=1.0, top_k=0)
+        with _pytest.raises(ValueError, match="temperature"):
+            lm.make_generate(2, 2, temperature=-0.5)

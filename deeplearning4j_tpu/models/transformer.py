@@ -179,14 +179,7 @@ class TransformerLM:
             block_fn = jax.checkpoint(block_fn)
         for blk in params["blocks"]:
             h = block_fn(blk, h)
-        h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
-        # tied unembedding as a bf16 MXU matmul with f32 accumulation —
-        # a plain f32 matmul here runs at a fraction of the bf16 rate and
-        # this [b*t, d] @ [d, V] projection is one of the largest in the step
-        logits = jax.lax.dot_general(
-            policy.cast_compute(h), policy.cast_compute(params["embed"]),
-            (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        return policy.cast_output(logits)
+        return policy.cast_output(self._unembed(params, h))
 
     def loss(self, params, tokens, *, mesh=None, sequence_parallel=False):
         """Next-token cross entropy (mean over positions)."""
@@ -284,8 +277,94 @@ class TransformerLM:
         return self.make_train_step()
 
     # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def evaluate_perplexity(self, tokens) -> float:
+        """Corpus perplexity ``exp(mean next-token NLL)`` over [b, t]
+        token batches (the LM analogue of ``Evaluation.stats`` accuracy:
+        eval/Evaluation.java:90-147 evaluates classifiers; an LM's
+        standard metric is perplexity)."""
+        if self.params is None:
+            self.init()
+        return float(jnp.exp(self._loss_jit(
+            self.params, jnp.asarray(tokens, jnp.int32))))
+
+    @functools.cached_property
+    def _loss_jit(self):
+        return jax.jit(self.loss)
+
+    # ------------------------------------------------------------------
     # autoregressive decoding (KV cache)
     # ------------------------------------------------------------------
+    def _unembed(self, params, h):
+        """Final layernorm + tied unembedding on [..., D] hidden →
+        [..., V] f32 logits. The matmul runs with compute-dtype (bf16)
+        operands and f32 accumulation — one of the largest matmuls in
+        the step, so a plain f32 matmul here would cost MXU rate."""
+        policy = self.policy
+        hf = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
+        return lax.dot_general(
+            policy.cast_compute(hf), policy.cast_compute(params["embed"]),
+            (((hf.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _prefill(self, params, prompt, max_new_tokens: int):
+        """One parallel forward over the prompt capturing per-layer K/V.
+        Returns ``(h_last [b, D], cache)`` with cache entries padded out
+        to ``prompt_len + max_new_tokens`` positions."""
+        policy = self.policy
+        cdt = policy.compute_dtype
+        prompt_len = prompt.shape[1]
+        h = jnp.take(params["embed"], prompt, axis=0)
+        h = h + params["pos"][:prompt_len][None]
+        h = policy.cast_compute(h)
+        cache = []
+        pad_t = ((0, 0), (0, max_new_tokens), (0, 0), (0, 0))
+        for blk in params["blocks"]:
+            h, kk, vv = self._block(blk, h)
+            cache.append({"k": jnp.pad(kk.astype(cdt), pad_t),
+                          "v": jnp.pad(vv.astype(cdt), pad_t)})
+        return h[:, -1], cache
+
+    def _decode_token(self, params, cache, tok, t, total: int):
+        """Consume one token per row at position ``t`` (traced) against
+        the cache, through the SAME ``_block`` math as training/prefill —
+        only the attention core differs. Returns ``(h_last, new_cache)``."""
+        policy = self.policy
+        cdt = policy.compute_dtype
+        B = tok.shape[0]
+        h = jnp.take(params["embed"], tok, axis=0) + params["pos"][t]
+        h = policy.cast_compute(h)[:, None, :]              # [B, 1, D]
+        live = (jnp.arange(total) <= t)[None, :]            # [1, total]
+        new_cache = []
+
+        def cached_attention(c):
+            def attn(q, kk, vv):
+                ck = lax.dynamic_update_slice(
+                    c["k"], kk.astype(cdt), (0, t, 0, 0))
+                cv = lax.dynamic_update_slice(
+                    c["v"], vv.astype(cdt), (0, t, 0, 0))
+                new_cache.append({"k": ck, "v": cv})
+                return dot_product_attention(
+                    q, ck, cv, mask=jnp.broadcast_to(live, (B, total)))
+            return attn
+
+        for blk, c in zip(params["blocks"], cache):
+            h, _, _ = self._block(blk, h, attention=cached_attention(c))
+        return h[:, 0], new_cache
+
+    def _validate_decode_args(self, prompt_len, max_new_tokens):
+        total = prompt_len + max_new_tokens
+        if prompt_len < 1:
+            raise ValueError("prompt_len must be >= 1")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {total} exceeds "
+                f"max_len={self.max_len}")
+        return total
+
     def make_generate(self, prompt_len: int, max_new_tokens: int, *,
                       temperature: float = 0.0, top_k: Optional[int] = None):
         """Build a jitted ``gen(params, prompt, key) -> [b, total]`` decoder.
@@ -299,29 +378,12 @@ class TransformerLM:
         per-token dispatch. ``temperature=0`` decodes greedily; otherwise
         samples from ``softmax(logits/temperature)`` filtered to ``top_k``.
         """
-        total = prompt_len + max_new_tokens
-        if prompt_len < 1:
-            raise ValueError("prompt_len must be >= 1")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if total > self.max_len:
-            raise ValueError(
-                f"prompt_len + max_new_tokens = {total} exceeds "
-                f"max_len={self.max_len}")
+        total = self._validate_decode_args(prompt_len, max_new_tokens)
         if top_k is not None and not 1 <= top_k <= self.vocab_size:
             raise ValueError(
                 f"top_k={top_k} must be in [1, vocab_size={self.vocab_size}]")
         if temperature < 0.0:
             raise ValueError(f"temperature={temperature} must be >= 0")
-        policy = self.policy
-        H, Dh = self.num_heads, self.d_model // self.num_heads
-
-        def unembed_logits(params, h_last):
-            hf = _layernorm(h_last, params["ln_f"]["g"], params["ln_f"]["b"])
-            return lax.dot_general(
-                policy.cast_compute(hf), policy.cast_compute(params["embed"]),
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)              # [b, V]
 
         def sample(logits, key):
             if temperature == 0.0:
@@ -335,46 +397,16 @@ class TransformerLM:
                 jnp.int32), key
 
         def gen(params, prompt, key):
-            b = prompt.shape[0]
-            cdt = policy.compute_dtype
-
             # ---- prefill: one parallel forward over the prompt
-            h = jnp.take(params["embed"], prompt, axis=0)
-            h = h + params["pos"][:prompt_len][None]
-            h = policy.cast_compute(h)
-            cache = []
-            pad_t = ((0, 0), (0, max_new_tokens), (0, 0), (0, 0))
-            for blk in params["blocks"]:
-                h, kk, vv = self._block(blk, h)
-                cache.append({"k": jnp.pad(kk.astype(cdt), pad_t),
-                              "v": jnp.pad(vv.astype(cdt), pad_t)})
-            first, key = sample(unembed_logits(params, h[:, -1]), key)
+            h_last, cache = self._prefill(params, prompt, max_new_tokens)
+            first, key = sample(self._unembed(params, h_last), key)
 
-            # ---- decode: one token per scan step against the cache,
-            # sharing _block's math; only the attention core differs
+            # ---- decode: one token per scan step against the cache
             def step(carry, t):
                 cache, tok, key = carry
-                h = jnp.take(params["embed"], tok, axis=0) + params["pos"][t]
-                h = policy.cast_compute(h)[:, None, :]          # [b, 1, D]
-                live = (jnp.arange(total) <= t)[None, :]        # [1, total]
-                new_cache = []
-
-                def cached_attention(c):
-                    def attn(q, kk, vv):
-                        ck = lax.dynamic_update_slice(
-                            c["k"], kk.astype(cdt), (0, t, 0, 0))
-                        cv = lax.dynamic_update_slice(
-                            c["v"], vv.astype(cdt), (0, t, 0, 0))
-                        new_cache.append({"k": ck, "v": cv})
-                        return dot_product_attention(
-                            q, ck, cv,
-                            mask=jnp.broadcast_to(live, (b, total)))
-                    return attn
-
-                for blk, c in zip(params["blocks"], cache):
-                    h, _, _ = self._block(blk, h,
-                                          attention=cached_attention(c))
-                nxt, key = sample(unembed_logits(params, h[:, 0]), key)
+                h_last, new_cache = self._decode_token(
+                    params, cache, tok, t, total)
+                nxt, key = sample(self._unembed(params, h_last), key)
                 return (new_cache, nxt, key), nxt
 
             # steps consume generated tokens at positions p .. total-2,
@@ -388,23 +420,97 @@ class TransformerLM:
 
         return jax.jit(gen)
 
-    def generate(self, prompt, max_new_tokens: int, *,
-                 temperature: float = 0.0, top_k: Optional[int] = None,
-                 seed: int = 0):
-        """Decode ``max_new_tokens`` past ``prompt`` ([b, t] int32).
-        Compiles one program per (shape, sampling) signature and caches it."""
+    def make_generate_beam(self, prompt_len: int, max_new_tokens: int,
+                           beam_size: int):
+        """Build a jitted ``gen(params, prompt) -> (seqs, scores)`` beam
+        decoder: ``seqs`` [b, beam, prompt_len+max_new] (best beam first),
+        ``scores`` [b, beam] summed token log-probs.
+
+        Beam counterpart of the reference's ImageLSTM caption search
+        (nn/layers/recurrent.py beam_search), on the KV cache: beams ride
+        the batch dim ([b*beam] rows), each scan step extends every beam,
+        takes the top ``beam_size`` of the b×(beam·V) candidates, and
+        reorders cache rows by parent beam with one gather."""
+        total = self._validate_decode_args(prompt_len, max_new_tokens)
+        K, V = beam_size, self.vocab_size
+        if not 1 <= K <= V:
+            raise ValueError(f"beam_size={K} must be in [1, vocab={V}]")
+
+        def gen(params, prompt):
+            b = prompt.shape[0]
+            h_last, cache = self._prefill(params, prompt, max_new_tokens)
+            logp0 = jax.nn.log_softmax(self._unembed(params, h_last), -1)
+            scores, tok0 = lax.top_k(logp0, K)              # [b, K]
+            tok0 = tok0.astype(jnp.int32)
+            # beams ride the batch dim, batch-major: row = batch*K + beam
+            cache = [{"k": jnp.repeat(c["k"], K, axis=0),
+                      "v": jnp.repeat(c["v"], K, axis=0)} for c in cache]
+            seqs = jnp.zeros((b, K, max_new_tokens), jnp.int32)
+            seqs = lax.dynamic_update_slice(
+                seqs, tok0[:, :, None], (0, 0, 0))
+
+            def step(carry, ti):
+                cache, seqs, scores, prev = carry
+                t, i = ti
+                h_last, cache = self._decode_token(
+                    params, cache, prev.reshape(b * K), t, total)
+                logp = jax.nn.log_softmax(self._unembed(params, h_last), -1)
+                cand = scores[:, :, None] + logp.reshape(b, K, V)
+                new_scores, idx = lax.top_k(cand.reshape(b, K * V), K)
+                parent = idx // V                            # [b, K]
+                tok = (idx % V).astype(jnp.int32)
+                rows = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+                cache = [{"k": c["k"][rows], "v": c["v"][rows]}
+                         for c in cache]
+                seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+                seqs = lax.dynamic_update_slice(
+                    seqs, tok[:, :, None], (0, 0, i))
+                return (cache, seqs, new_scores, tok), None
+
+            ts = jnp.arange(prompt_len, total - 1)           # consumed pos
+            slots = jnp.arange(1, max_new_tokens)            # written slot
+            (cache, seqs, scores, _), _ = lax.scan(
+                step, (cache, seqs, scores, tok0), (ts, slots))
+            out = jnp.concatenate(
+                [jnp.repeat(prompt[:, None], K, axis=1), seqs], axis=2)
+            return out, scores
+
+        return jax.jit(gen)
+
+    def _cached_decoder(self, sig, factory):
+        """Lazy per-signature compile cache shared by the decode APIs."""
         if self.params is None:
             self.init()
-        prompt = jnp.asarray(prompt, jnp.int32)
-        sig = (prompt.shape, max_new_tokens, temperature, top_k)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
         fn = cache.get(sig)
         if fn is None:
-            fn = cache[sig] = self.make_generate(
+            fn = cache[sig] = factory()
+        return fn
+
+    def generate_beam(self, prompt, max_new_tokens: int, beam_size: int = 4):
+        """Beam-search decode ``max_new_tokens`` past ``prompt`` ([b, t]).
+        Returns ``(seqs [b, beam, t+max_new], scores [b, beam])``,
+        best beam first. Compiled per (shape, beam) signature."""
+        prompt = jnp.asarray(prompt, jnp.int32)
+        fn = self._cached_decoder(
+            ("beam", prompt.shape, max_new_tokens, beam_size),
+            lambda: self.make_generate_beam(
+                prompt.shape[1], max_new_tokens, beam_size))
+        return fn(self.params, prompt)
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seed: int = 0):
+        """Decode ``max_new_tokens`` past ``prompt`` ([b, t] int32).
+        Compiles one program per (shape, sampling) signature and caches it."""
+        prompt = jnp.asarray(prompt, jnp.int32)
+        fn = self._cached_decoder(
+            (prompt.shape, max_new_tokens, temperature, top_k),
+            lambda: self.make_generate(
                 prompt.shape[1], max_new_tokens,
-                temperature=temperature, top_k=top_k)
+                temperature=temperature, top_k=top_k))
         return fn(self.params, prompt, jax.random.PRNGKey(seed))
 
     # ------------------------------------------------------------------

@@ -287,3 +287,63 @@ class TestTransformerGenerate:
             lm.make_generate(2, 2, temperature=1.0, top_k=0)
         with _pytest.raises(ValueError, match="temperature"):
             lm.make_generate(2, 2, temperature=-0.5)
+
+
+class TestTransformerBeamSearch:
+    def _lm(self):
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        return TransformerLM(vocab_size=32, d_model=32, num_heads=4,
+                             num_layers=2, max_len=24, seed=13).init()
+
+    def test_beam1_equals_greedy(self):
+        lm = self._lm()
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(0, 32, (2, 5)), jnp.int32)
+        greedy = lm.generate(prompt, max_new_tokens=7)
+        seqs, scores = lm.generate_beam(prompt, max_new_tokens=7,
+                                        beam_size=1)
+        assert seqs.shape == (2, 1, 12) and scores.shape == (2, 1)
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                      np.asarray(greedy))
+
+    def test_scores_are_true_log_probs_and_sorted(self):
+        """Each beam's score must equal the ACTUAL summed next-token
+        log-prob of its sequence under the model (recomputed via the full
+        forward), and beams come back best-first."""
+        lm = self._lm()
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, 32, (1, 4)), jnp.int32)
+        p, n = 4, 6
+        seqs, scores = lm.generate_beam(prompt, max_new_tokens=n,
+                                        beam_size=3)
+        s = np.asarray(scores[0])
+        assert (np.diff(s) <= 1e-6).all(), "beams not sorted best-first"
+        for bi in range(3):
+            seq = seqs[0, bi][None]                       # [1, p+n]
+            logits = lm.forward(lm.params, seq)
+            logp = jax.nn.log_softmax(
+                jnp.asarray(logits, jnp.float32), axis=-1)
+            # generated tokens sit at positions p..p+n-1, each predicted
+            # from the previous position
+            tot = sum(float(logp[0, t - 1, int(seq[0, t])])
+                      for t in range(p, p + n))
+            np.testing.assert_allclose(s[bi], tot, rtol=2e-4, atol=2e-4)
+
+    def test_beams_are_distinct_sequences(self):
+        """Distinct (parent, token) extensions of distinct prefixes stay
+        distinct: no returned beam may duplicate another."""
+        lm = self._lm()
+        prompt = jnp.asarray(
+            np.random.default_rng(4).integers(0, 32, (3, 4)), jnp.int32)
+        seqs, _ = lm.generate_beam(prompt, max_new_tokens=8, beam_size=4)
+        for row in np.asarray(seqs):
+            uniq = {tuple(beam) for beam in row}
+            assert len(uniq) == 4
+
+    def test_beam_guard(self):
+        import pytest as _pytest
+
+        lm = self._lm()
+        with _pytest.raises(ValueError, match="beam_size"):
+            lm.make_generate_beam(4, 4, 33)

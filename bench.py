@@ -489,19 +489,79 @@ def _await_backend(timeout_s: float = None):
             "error", f"backend init did not complete in {timeout_s:.0f}s "
                      "(wedged device grant?)")
         _log(f"BACKEND UNAVAILABLE: {err}")
-        print(json.dumps({
-            "metric": "transformer_lm_1024ctx_train_tokens_per_sec_per_chip",
-            "value": None, "unit": "tokens/sec", "vs_baseline": None,
-            "extras": {"error": f"backend unavailable: {err}"},
-        }), flush=True)
+        print(_result_line({"error": f"backend unavailable: {err}"},
+                           None, float("nan")), flush=True)
         os._exit(0)
     _log(f"backend up: {result['devices']}")
+
+
+def _result_line(extras, headline_value, vs_baseline):
+    return json.dumps({
+        "metric": "transformer_lm_1024ctx_train_tokens_per_sec_per_chip",
+        "value": headline_value,
+        "unit": "tokens/sec",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline == vs_baseline
+        else None,
+        "extras": extras,
+    })
+
+
+PARTIAL_PATH = "bench_partial.json"
+
+
+def _flush_partial(extras, complete=False):
+    """Persist the configs measured so far to a sidecar file after every
+    config. The SIGTERM handler below cannot fire while the main thread
+    is blocked inside a non-signal-aware PJRT/XLA call (the wedged-grant
+    hang), so the sidecar — not the handler — is the durable record; the
+    handler covers the kill-between-configs case on stdout."""
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            json.dump({"complete": complete, "extras": extras}, f)
+    except OSError as e:
+        _log(f"partial flush failed: {e}")
+
+
+def _install_partial_emitter(extras):
+    """If the driver's timeout SIGTERMs the bench mid-run, emit the JSON
+    line with every config measured so far instead of dying silently —
+    a partial record beats no record (a round-4 kill mid-transformer
+    lost all seven earlier configs). Restored to SIG_DFL before the
+    successful final print so a late TERM can't append a second,
+    contradictory line."""
+    import signal
+
+    def on_term(signum, frame):
+        extras.setdefault(
+            "error", f"bench terminated by signal {signum} before "
+                     "completion; extras above are the configs that "
+                     "finished")
+        tf = extras.get("transformer_lm") or {}
+        print(_result_line(extras, tf.get("tokens_per_sec"), float("nan")),
+              flush=True)
+        import os
+        os._exit(1)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except (ValueError, OSError):  # non-main thread / platform quirk
+        pass
+
+
+def _uninstall_partial_emitter():
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    except (ValueError, OSError):
+        pass
 
 
 def main() -> None:
     _await_backend()
     extras = {"peak_tflops_bf16_per_chip": PEAK_TFLOPS_BF16,
               "chip": "TPU v5e (1 chip)"}
+    _install_partial_emitter(extras)
     for name, fn in [("gemm", bench_gemm), ("mnist_mlp", bench_mlp),
                      ("lenet5", bench_lenet),
                      ("char_lstm", bench_char_lstm),
@@ -513,6 +573,7 @@ def main() -> None:
         except Exception as e:  # keep the bench robust to one bad config
             extras[name] = {"error": str(e)[:200]}
             _log(f"{name} FAILED: {e}")
+        _flush_partial(extras)
 
     try:
         tf, vs_baseline = bench_transformer()
@@ -524,14 +585,9 @@ def main() -> None:
         headline_value = None
         vs_baseline = float("nan")
 
-    print(json.dumps({
-        "metric": "transformer_lm_1024ctx_train_tokens_per_sec_per_chip",
-        "value": headline_value,
-        "unit": "tokens/sec",
-        "vs_baseline": round(vs_baseline, 2) if vs_baseline == vs_baseline
-        else None,
-        "extras": extras,
-    }))
+    _uninstall_partial_emitter()
+    _flush_partial(extras, complete=True)
+    print(_result_line(extras, headline_value, vs_baseline))
 
 
 if __name__ == "__main__":

@@ -47,6 +47,12 @@ from deeplearning4j_tpu.parallel.expert_parallel import (  # noqa: F401
     moe_ffn,
     shard_moe_params,
 )
+from deeplearning4j_tpu.parallel.fsdp import (  # noqa: F401
+    FSDP,
+    fsdp_shardings,
+    fsdp_spec,
+    shard_tree,
+)
 from deeplearning4j_tpu.parallel.statetracker import (  # noqa: F401
     FileStateTracker,
     InMemoryStateTracker,

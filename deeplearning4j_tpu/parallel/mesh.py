@@ -91,9 +91,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
-    """Shard axis 0 (batch) over 'data'; replicate the rest."""
-    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+def batch_sharding(mesh: Mesh, ndim: int = 2,
+                   axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard axis 0 (batch) over ``axis``; replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
 def shard_leading_axis(tree, mesh: Mesh, axis_name: str):

@@ -53,10 +53,17 @@ class ParallelWrapper:
     """
 
     def __init__(self, network, mesh: Optional[Mesh] = None,
-                 donate: bool = True):
+                 donate: bool = True, fsdp: bool = False):
+        """``fsdp=True`` shards parameters AND updater state over the
+        ``data`` axis (ZeRO-3, parallel/fsdp.py) instead of replicating —
+        per-device state drops ~N×; GSPMD all-gathers weights on use and
+        reduce-scatters gradients. Batch sizes must then divide the data
+        axis (no ragged-tail fallback: it would need a gather/reshard
+        round-trip per tail)."""
         self.network = network
         self.mesh = mesh or build_mesh()
         self._donate = donate
+        self.fsdp = fsdp
         network._ensure_init()
         self._place_params()
 
@@ -65,12 +72,30 @@ class ParallelWrapper:
         return self.mesh.shape[DATA_AXIS]
 
     def _place_params(self):
-        """Replicate params/updater/net state across the mesh."""
+        """Replicate (or FSDP-shard) params/updater/net state."""
         repl = NamedSharding(self.mesh, P())
         net = self.network
-        net.params = jax.device_put(net.params, repl)
-        net.updater_state = jax.device_put(net.updater_state, repl)
+        if self.fsdp:
+            from deeplearning4j_tpu.parallel.fsdp import shard_tree
+
+            net.params, self._param_shardings = shard_tree(
+                net.params, self.mesh, with_shardings=True)
+            net.updater_state, self._upd_shardings = shard_tree(
+                net.updater_state, self.mesh, with_shardings=True)
+        else:
+            net.params = jax.device_put(net.params, repl)
+            net.updater_state = jax.device_put(net.updater_state, repl)
         net.net_state = jax.device_put(net.net_state, repl)
+
+    @functools.cached_property
+    def _fsdp_train_step(self):
+        """The network's step re-jitted with out_shardings pinned to the
+        FSDP specs so donated updates keep state sharded across steps."""
+        return jax.jit(
+            self.network._step_impl,
+            donate_argnums=(0, 1, 2) if self._donate else (),
+            out_shardings=(self._param_shardings, self._upd_shardings,
+                           None, None, None))
 
     def _shard_batch(self, arr):
         if arr is None:
@@ -88,6 +113,14 @@ class ParallelWrapper:
         different steps on the mesh."""
         net = self.network
         if not self._shardable():
+            if self.fsdp:
+                # the network's own fit path has no pinned out_shardings:
+                # one step would silently re-replicate the state and lose
+                # the N-fold memory saving fsdp=True was chosen for
+                raise ValueError(
+                    "ParallelWrapper(fsdp=True) does not support "
+                    "TBPTT/non-SGD/pretrain/SCORE-lr/iterations>1 "
+                    "configs; use fsdp=False (replicated DP) for these")
             logger.info("ParallelWrapper: non-shardable config (TBPTT/"
                         "non-SGD/pretrain/SCORE-lr/iterations>1) — "
                         "delegating to the network's own fit path")
@@ -123,6 +156,11 @@ class ParallelWrapper:
         net = self.network
         dp = self.data_parallelism
         if ds.num_examples() % dp:
+            if self.fsdp:
+                raise ValueError(
+                    f"FSDP requires batch sizes divisible by the data "
+                    f"axis (got {ds.num_examples()} vs dp={dp}); pad or "
+                    f"drop the tail batch")
             # ragged tail batch (e.g. last CSV batch): ONE unsharded
             # optimizer step — same per-batch step count as the sharded
             # path (net.fit would run gc.iterations steps and over-weight
@@ -133,9 +171,10 @@ class ParallelWrapper:
             net._sgd_step(ds)
             net._post_iteration()
             return
+        step = self._fsdp_train_step if self.fsdp else net._train_step
         with self.mesh:
             net._rng, rng = jax.random.split(net._rng)
-            (net.params, net.updater_state, net.net_state, _, loss) = net._train_step(
+            (net.params, net.updater_state, net.net_state, _, loss) = step(
                 net.params, net.updater_state, net.net_state,
                 jnp.asarray(net.iteration_count, jnp.int32),
                 jnp.asarray(net._lr_scale_host, jnp.float32),

@@ -80,25 +80,57 @@ class FSDP:
     step consumes and produces 1/N-per-device state.
     """
 
+    _DONATED = object()
+
     def __init__(self, mesh: Mesh, params: Any, opt_state: Any,
                  axis: str = DATA_AXIS):
         self.mesh = mesh
         self.axis = axis
-        self.params, self.param_shardings = shard_tree(
+        self._params, self.param_shardings = shard_tree(
             params, mesh, axis, with_shardings=True)
-        self.opt_state, self.opt_shardings = shard_tree(
+        self._opt_state, self.opt_shardings = shard_tree(
             opt_state, mesh, axis, with_shardings=True)
+
+    @property
+    def params(self):
+        return self._checked(self._params, "params")
+
+    @property
+    def opt_state(self):
+        return self._checked(self._opt_state, "opt_state")
+
+    def _checked(self, val, name):
+        if val is FSDP._DONATED:
+            raise RuntimeError(
+                f"FSDP.{name} was donated to a jit_step call; the live "
+                "state is what that step returned (take ownership of "
+                ".params/.opt_state BEFORE the first step, as in the "
+                "class docstring)")
+        return val
 
     def jit_step(self, step_fn: Callable, *, donate: bool = True,
                  aux_sharding: Optional[Any] = None) -> Callable:
         """Jit ``step_fn(params, opt_state, *args) -> (params, opt_state,
         aux)`` with out_shardings pinned to the FSDP specs. ``aux`` is
-        left unconstrained (or pass ``aux_sharding``)."""
-        return jax.jit(
+        left unconstrained (or pass ``aux_sharding``).
+
+        With ``donate=True`` the first call invalidates whatever buffers
+        this trainer still references, so the wrapper drops them — a
+        later ``.params`` read raises a clear error instead of jax's
+        deleted-buffer one."""
+        fn = jax.jit(
             step_fn,
             donate_argnums=(0, 1) if donate else (),
             out_shardings=(self.param_shardings, self.opt_shardings,
                            aux_sharding))
+        if not donate:
+            return fn
+
+        def wrapper(*args, **kwargs):
+            self._params = self._opt_state = FSDP._DONATED
+            return fn(*args, **kwargs)
+
+        return wrapper
 
     def batch_sharding(self, ndim: int) -> NamedSharding:
         """Standard data-parallel batch sharding (leading dim)."""

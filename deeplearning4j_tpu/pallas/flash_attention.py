@@ -206,12 +206,14 @@ def flash_attention_fwd(
 def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
                    scale: Optional[float] = None, block_k: int = 1024,
                    q_offset=0, k_offset=0):
-    """Chunked flash backward. Given the merged ``lse`` each key block's
-    gradient contribution is independent, so this scans key blocks with
-    O(t·block) live memory. Works for any sub-span of a larger attention
-    (ring backward): ``q_offset``/``k_offset`` are the absolute positions
-    of q[0] / k[0] (may be traced), ``lse``/``delta`` must come from the
-    FULL merged attention.
+    """Chunked flash backward (XLA scan). The production paths use the
+    Pallas kernels (:func:`flash_backward_pallas`, used by both the
+    custom_vjp and the ring backward); this scan version remains as the
+    independently-derived reference implementation the kernel parity
+    tests check against, and as the only path supporting arbitrary
+    position offsets: ``q_offset``/``k_offset`` are the absolute
+    positions of q[0] / k[0] (may be traced), ``lse``/``delta`` must
+    come from the FULL merged attention.
 
     q/out/do: [b, tq, h, d]; k/v: [b, tkv, h, d]; lse: [b, h, tq].
     Returns (dq, dk, dv) in the input layouts (float32).
@@ -375,8 +377,9 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
     """Pallas flash backward: the score/probability tiles stay in VMEM
     (two kernels: dk/dv over key blocks, dq over query blocks), unlike
     :func:`flash_backward` whose XLA scan round-trips O(t·block) f32
-    temps through HBM. Self-attention spans only (positions 0..t); the
-    ring path keeps the scan version for its traced offsets.
+    temps through HBM. Aligned spans only (block-relative positions ==
+    absolute): used by BOTH the custom_vjp and the ring backward, whose
+    full/diag/skip block trichotomy never needs offsets.
 
     Returns (dq, dk, dv) as float32 in the input layouts.
     """

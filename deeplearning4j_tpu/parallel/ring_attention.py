@@ -148,8 +148,13 @@ def _ring_flash_fwd_rule(cfg, q_blk, k_blk, v_blk):
 
 def _ring_flash_bwd_rule(cfg, res, do):
     """Second ring pass: dq accumulates locally; (dk, dv) accumulators
-    travel with their k/v blocks and arrive home after n rotations."""
-    from deeplearning4j_tpu.pallas.flash_attention import flash_backward
+    travel with their k/v blocks and arrive home after n rotations.
+    Per-block gradients run through the Pallas backward kernels (score
+    tiles stay in VMEM); blocks never need position offsets because the
+    ring visits each block as full (below diagonal), diag (aligned
+    spans), or skip."""
+    from deeplearning4j_tpu.pallas.flash_attention import (
+        flash_backward_pallas)
 
     q_blk, k_blk, v_blk, out, lse = res
     n = cfg.n_ring
@@ -160,8 +165,9 @@ def _ring_flash_bwd_rule(cfg, res, do):
 
     def block_grads(kc, vc, causal_mode):
         def run(causal):
-            return flash_backward(q_blk, kc, vc, out, lse, do,
-                                  causal=causal, scale=cfg.scale)
+            return flash_backward_pallas(q_blk, kc, vc, out, lse, do,
+                                         causal=causal, scale=cfg.scale,
+                                         interpret=cfg.interpret)
 
         def full(_):
             return run(False)

@@ -36,6 +36,24 @@ from deeplearning4j_tpu.pallas.flash_attention import (
     flash_attention, flash_default_interpret)
 
 
+def _rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on [b, t, h, d] at absolute ``positions``
+    [t] (may be traced). Angles in f32, result in x's dtype. Rotation is
+    applied to q/k BEFORE attention, so it composes unchanged with the
+    XLA, Pallas-flash, and ring paths."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _layernorm(x, g, b, eps=1e-5):
     # statistics in >=f32, but the result stays in x's dtype: multiplying
     # by the f32 g/b params directly would promote the whole residual
@@ -55,12 +73,22 @@ class TransformerLM:
                  num_layers: int = 4, d_ff: Optional[int] = None,
                  max_len: int = 512, lr: float = 3e-4, seed: int = 0,
                  dtype_policy: str = "float32", attn_impl: str = "auto",
-                 remat: bool = False):
+                 remat: bool = False, pos_encoding: str = "learned"):
         assert d_model % num_heads == 0
         # "auto": Pallas flash kernel when a TPU backend is attached and
         # head_dim maps onto lane tiles; "xla" / "flash" force a path
         assert attn_impl in ("auto", "xla", "flash")
         self.attn_impl = attn_impl
+        # "learned": additive position table (the default, bounded by
+        # max_len); "rope": rotary embedding on q/k — relative positions,
+        # the modern long-context choice
+        assert pos_encoding in ("learned", "rope")
+        if pos_encoding == "rope" and (d_model // num_heads) % 2:
+            raise ValueError(
+                f"RoPE needs an even head_dim (got "
+                f"{d_model // num_heads}: d_model={d_model} / "
+                f"num_heads={num_heads}); the rotation pairs dimensions")
+        self.pos_encoding = pos_encoding
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) instead of keeping them live across the whole
         # step — trades ~1/3 more FLOPs for O(sqrt) activation memory, the
@@ -92,10 +120,11 @@ class TransformerLM:
         keys = jax.random.split(key, 2 + 6 * self.num_layers)
         params: Dict[str, Any] = {
             "embed": jax.random.normal(keys[0], (V, D), dt) * 0.02,
-            "pos": jax.random.normal(keys[1], (L, D), dt) * 0.02,
             "ln_f": {"g": jnp.ones((D,), dt), "b": jnp.zeros((D,), dt)},
             "blocks": [],
         }
+        if self.pos_encoding == "learned":
+            params["pos"] = jax.random.normal(keys[1], (L, D), dt) * 0.02
         for i in range(self.num_layers):
             k = keys[2 + 6 * i:2 + 6 * (i + 1)]
             params["blocks"].append({
@@ -129,13 +158,16 @@ class TransformerLM:
         return "xla"
 
     def _block(self, blk, h, *, mesh: Optional[Mesh] = None,
-               sequence_parallel: bool = False, attention=None):
+               sequence_parallel: bool = False, attention=None,
+               positions=None):
         """One pre-norm block on ``h`` [b, t, D]. Returns ``(h, k, v)``
         with k/v in [b, t, H, Dh] — ``forward`` discards them (XLA DCE),
-        the KV-cache prefill keeps them. ``attention(q, k, v) -> o``
-        overrides the causal self-attention core (the KV-cache decode
-        attends against the cache instead) while sharing every other
-        line of block math."""
+        the KV-cache prefill keeps them (k/v are post-RoPE under
+        ``pos_encoding="rope"``). ``attention(q, k, v) -> o`` overrides
+        the causal self-attention core (the KV-cache decode attends
+        against the cache instead) while sharing every other line of
+        block math. ``positions`` [t] are the absolute positions for
+        RoPE (default 0..t-1; the decode step passes its cache slot)."""
         policy = self.policy
         b, t = h.shape[0], h.shape[1]
         x = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
@@ -145,6 +177,11 @@ class TransformerLM:
             b, t, self.num_heads, -1)
         v = (x @ policy.cast_compute(blk["attn"]["wv"])).reshape(
             b, t, self.num_heads, -1)
+        if self.pos_encoding == "rope":
+            if positions is None:
+                positions = jnp.arange(t)
+            q = _rope(q, positions)
+            k = _rope(k, positions)
         if attention is not None:
             o = attention(q, k, v)
         elif sequence_parallel and mesh is not None:
@@ -168,7 +205,8 @@ class TransformerLM:
         policy = self.policy
         b, t = tokens.shape
         h = jnp.take(params["embed"], tokens, axis=0)
-        h = h + params["pos"][:t][None]
+        if self.pos_encoding == "learned":
+            h = h + params["pos"][:t][None]
         h = policy.cast_compute(h)
 
         def block_fn(blk, h):
@@ -316,7 +354,8 @@ class TransformerLM:
         cdt = policy.compute_dtype
         prompt_len = prompt.shape[1]
         h = jnp.take(params["embed"], prompt, axis=0)
-        h = h + params["pos"][:prompt_len][None]
+        if self.pos_encoding == "learned":
+            h = h + params["pos"][:prompt_len][None]
         h = policy.cast_compute(h)
         cache = []
         pad_t = ((0, 0), (0, max_new_tokens), (0, 0), (0, 0))
@@ -333,7 +372,9 @@ class TransformerLM:
         policy = self.policy
         cdt = policy.compute_dtype
         B = tok.shape[0]
-        h = jnp.take(params["embed"], tok, axis=0) + params["pos"][t]
+        h = jnp.take(params["embed"], tok, axis=0)
+        if self.pos_encoding == "learned":
+            h = h + params["pos"][t]
         h = policy.cast_compute(h)[:, None, :]              # [B, 1, D]
         live = (jnp.arange(total) <= t)[None, :]            # [1, total]
         new_cache = []
@@ -350,7 +391,8 @@ class TransformerLM:
             return attn
 
         for blk, c in zip(params["blocks"], cache):
-            h, _, _ = self._block(blk, h, attention=cached_attention(c))
+            h, _, _ = self._block(blk, h, attention=cached_attention(c),
+                                  positions=jnp.asarray(t)[None])
         return h[:, 0], new_cache
 
     def _validate_decode_args(self, prompt_len, max_new_tokens):
@@ -359,10 +401,13 @@ class TransformerLM:
             raise ValueError("prompt_len must be >= 1")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if total > self.max_len:
+        # only the learned position TABLE bounds the context; RoPE has no
+        # table and may decode past max_len (relative positions)
+        if total > self.max_len and self.pos_encoding == "learned":
             raise ValueError(
                 f"prompt_len + max_new_tokens = {total} exceeds "
-                f"max_len={self.max_len}")
+                f"max_len={self.max_len} (learned position table; use "
+                f"pos_encoding='rope' to decode past it)")
         return total
 
     def make_generate(self, prompt_len: int, max_new_tokens: int, *,
@@ -527,12 +572,14 @@ class TransformerLM:
                 "ln2": {"g": P(), "b": P()},
                 "mlp": {"w1": col, "b1": P(MODEL_AXIS), "w2": row, "b2": P()},
             })
-        return {
+        specs = {
             "embed": row if shard_data_embed else P(),
-            "pos": P(),
             "ln_f": {"g": P(), "b": P()},
             "blocks": blocks,
         }
+        if self.pos_encoding == "learned":
+            specs["pos"] = P()
+        return specs
 
     def shard_params(self, mesh: Mesh, specs: Optional[Dict[str, Any]] = None):
         """Place params + opt state on the mesh with TP shardings.

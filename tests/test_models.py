@@ -347,3 +347,92 @@ class TestTransformerBeamSearch:
         lm = self._lm()
         with _pytest.raises(ValueError, match="beam_size"):
             lm.make_generate_beam(4, 4, 33)
+
+
+class TestRoPE:
+    def test_relative_position_property(self):
+        """RoPE scores depend only on relative offsets: shifting all
+        positions by a constant must leave q·k scores unchanged."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import _rope
+
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(1, 6, 2, 16)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 6, 2, 16)), jnp.float32)
+        pos = jnp.arange(6)
+        s0 = jnp.einsum("bqhd,bkhd->bhqk", _rope(q, pos), _rope(k, pos))
+        s5 = jnp.einsum("bqhd,bkhd->bhqk", _rope(q, pos + 5),
+                        _rope(k, pos + 5))
+        np.testing.assert_allclose(np.asarray(s5), np.asarray(s0),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rope_lm_trains_and_decodes(self):
+        """A RoPE LM must train, and KV-cache greedy decode must match
+        the naive full-forward decode (pins prefill/decode rotation
+        consistency at the cache slot)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        lm = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                           num_layers=2, max_len=32, lr=5e-3, seed=0,
+                           pos_encoding="rope").init()
+        assert "pos" not in lm.params
+        period = 8
+        tok = jnp.asarray(np.tile(np.arange(period), (8, 4))[:, :32],
+                          jnp.int32)
+        step = lm.make_train_step()
+        first = lm.fit_batch(tok, train_step=step)
+        for _ in range(150):
+            last = lm.fit_batch(tok, train_step=step)
+        assert last < first * 0.2
+
+        prompt = jnp.asarray(
+            np.tile(np.arange(period), (1, 2))[:, :12], jnp.int32)
+        out = lm.generate(prompt, max_new_tokens=8)
+        seq = prompt
+        for _ in range(8):
+            logits = lm.forward(lm.params, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+        # and the trained model continues the cycle
+        expect = [(12 + i) % period for i in range(8)]
+        assert np.asarray(out)[0, 12:].tolist() == expect
+
+    def test_rope_flash_matches_xla(self):
+        import jax
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        kw = dict(vocab_size=64, d_model=64, num_heads=4, num_layers=2,
+                  max_len=128, seed=7, pos_encoding="rope")
+        tok = np.random.default_rng(3).integers(0, 64, (2, 128)).astype(
+            np.int32)
+        xla = TransformerLM(**kw, attn_impl="xla").init()
+        fla = TransformerLM(**kw, attn_impl="flash").init()
+        gx = jax.grad(lambda p: xla.loss(p, tok))(xla.params)
+        gf = jax.grad(lambda p: fla.loss(p, tok))(fla.params)
+        for a, b in zip(jax.tree_util.tree_leaves(gx),
+                        jax.tree_util.tree_leaves(gf)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=1e-3)
+
+    def test_rope_guards_and_long_decode(self):
+        import pytest as _pytest
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        with _pytest.raises(ValueError, match="even head_dim"):
+            TransformerLM(vocab_size=16, d_model=96, num_heads=32,
+                          pos_encoding="rope")
+        # RoPE decodes past max_len (no position table); learned cannot
+        rope = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                             num_layers=1, max_len=8, seed=0,
+                             pos_encoding="rope").init()
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 16, (1, 6)), jnp.int32)
+        out = rope.generate(prompt, max_new_tokens=6)   # total 12 > 8
+        assert out.shape == (1, 12)
+        learned = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                                num_layers=1, max_len=8, seed=0).init()
+        with _pytest.raises(ValueError, match="learned position table"):
+            learned.generate(prompt, max_new_tokens=6)

@@ -204,3 +204,40 @@ class TestFlashBackwardPallas:
             np.testing.assert_allclose(
                 np.asarray(b, np.float32), np.asarray(a, np.float32),
                 rtol=0.1, atol=0.15)
+
+
+class TestFlashBackwardOffsets:
+    def test_split_query_span_grads_sum_to_full(self):
+        """flash_backward's q_offset path: causal attention over t=128
+        computed as two q-half calls (offsets 0 and 64) must reproduce
+        the full backward — dq halves concatenate, dk/dv contributions
+        sum. Pins the offset masking now that the ring path no longer
+        exercises it."""
+        from deeplearning4j_tpu.pallas.flash_attention import (
+            flash_attention_fwd, flash_backward)
+
+        t, half = 128, 64
+        q, k, v = _qkv(1, t, 2, 32, seed=12)
+        do = jnp.asarray(
+            np.random.default_rng(13).normal(size=q.shape), jnp.float32)
+        out, lse = flash_attention_fwd(q, k, v, causal=True,
+                                       block_q=64, block_k=64)
+        dq_full, dk_full, dv_full = flash_backward(
+            q, k, v, out, lse, do, causal=True)
+
+        pieces = []
+        for off in (0, half):
+            sl = slice(off, off + half)
+            pieces.append(flash_backward(
+                q[:, sl], k, v, out[:, sl], lse[:, :, sl], do[:, sl],
+                causal=True, q_offset=off, k_offset=0))
+        (dq0, dk0, dv0), (dq1, dk1, dv1) = pieces
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([dq0, dq1], axis=1)),
+            np.asarray(dq_full), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk0 + dk1),
+                                   np.asarray(dk_full),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv0 + dv1),
+                                   np.asarray(dv_full),
+                                   rtol=1e-4, atol=1e-4)

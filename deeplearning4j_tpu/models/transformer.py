@@ -102,6 +102,7 @@ class TransformerLM:
         self.max_len = max_len
         self.lr = lr
         self.seed = seed
+        self.dtype_policy_name = dtype_policy
         self.policy = dtypes_mod.policy_from_name(dtype_policy)
         self.params: Optional[Dict[str, Any]] = None
         self.opt_state: Optional[Dict[str, Any]] = None
@@ -313,6 +314,26 @@ class TransformerLM:
     @functools.cached_property
     def _default_step(self):
         return self.make_train_step()
+
+    # ------------------------------------------------------------------
+    # serialization
+    # ------------------------------------------------------------------
+    def get_config(self) -> Dict[str, Any]:
+        """Constructor kwargs sufficient to rebuild this model —
+        ``TransformerLM(**lm.get_config())`` (the checkpoint's
+        configuration.json; role of the DSL conf for the zoo networks)."""
+        return {
+            "vocab_size": self.vocab_size, "d_model": self.d_model,
+            "num_heads": self.num_heads, "num_layers": self.num_layers,
+            "d_ff": self.d_ff, "max_len": self.max_len, "lr": self.lr,
+            "seed": self.seed, "dtype_policy": self.dtype_policy_name,
+            "attn_impl": self.attn_impl, "remat": self.remat,
+            "pos_encoding": self.pos_encoding,
+        }
+
+    def _ensure_init(self):
+        if self.params is None:
+            self.init()
 
     # ------------------------------------------------------------------
     # evaluation

@@ -27,13 +27,15 @@ import jax.numpy as jnp
 
 
 def _escape(component: str) -> str:
-    """Escape '%' and '/' so user-chosen layer names containing '/' cannot
-    collide with the path delimiter."""
-    return component.replace("%", "%25").replace("/", "%2F")
+    """Escape '%', '/' and '[' so user-chosen layer names cannot collide
+    with the path delimiter or the '[i]' list-index encoding."""
+    return (component.replace("%", "%25").replace("/", "%2F")
+            .replace("[", "%5B"))
 
 
 def _unescape(component: str) -> str:
-    return component.replace("%2F", "/").replace("%25", "%")
+    return (component.replace("%5B", "[").replace("%2F", "/")
+            .replace("%25", "%"))
 
 
 def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -43,8 +45,25 @@ def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
             esc = _escape(str(k))
             sub_prefix = f"{prefix}/{esc}" if prefix else esc
             out.update(_flatten_tree(tree[k], sub_prefix))
+    elif isinstance(tree, (list, tuple)):
+        # lists (e.g. TransformerLM's per-layer blocks) flatten under
+        # "[i]" components; _unflatten_tree rebuilds them by pattern
+        for i, item in enumerate(tree):
+            comp = f"[{i}]"
+            sub_prefix = f"{prefix}/{comp}" if prefix else comp
+            out.update(_flatten_tree(item, sub_prefix))
     else:
         out[prefix] = np.asarray(tree)
+    return out
+
+
+def _listify(node: Any) -> Any:
+    """Convert dict nodes whose keys are all '[N]' back into lists."""
+    if not isinstance(node, dict):
+        return node
+    out = {k: _listify(v) for k, v in node.items()}
+    if out and all(k.startswith("[") and k.endswith("]") for k in out):
+        return [out[f"[{i}]"] for i in range(len(out))]
     return out
 
 
@@ -56,7 +75,7 @@ def _unflatten_tree(flat: Dict[str, np.ndarray]) -> Any:
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         node[parts[-1]] = jnp.asarray(value)
-    return root
+    return _listify(root)
 
 
 def _write_npz(zf: zipfile.ZipFile, name: str, tree: Any) -> None:
@@ -90,6 +109,17 @@ def _merge_into(template: Any, loaded: Any, path: str = "") -> Any:
                     "(truncated or incompatible archive)")
             out[k] = _merge_into(template[k], sub_loaded, sub_path)
         return out
+    if isinstance(template, (list, tuple)):
+        if not template and loaded is None:
+            # empty lists produce no npz keys, like empty dicts
+            return template
+        if not isinstance(loaded, list) or len(loaded) != len(template):
+            raise ValueError(
+                f"checkpoint entry {path!r} has {0 if loaded is None else len(loaded)}"
+                f" items, expected {len(template)}")
+        return type(template)(
+            _merge_into(t, l, f"{path}/[{i}]")
+            for i, (t, l) in enumerate(zip(template, loaded)))
     if loaded is None:
         return template
     return jnp.asarray(loaded, template.dtype) if hasattr(template, "dtype") else loaded
@@ -98,6 +128,8 @@ def _merge_into(template: Any, loaded: Any, path: str = "") -> Any:
 def _has_array_leaves(tree: Any) -> bool:
     if isinstance(tree, dict):
         return any(_has_array_leaves(v) for v in tree.values())
+    if isinstance(tree, (list, tuple)):
+        return any(_has_array_leaves(v) for v in tree)
     return True
 
 
@@ -106,28 +138,39 @@ class ModelSerializer:
 
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True) -> None:
+        from deeplearning4j_tpu.models.transformer import TransformerLM
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         model._ensure_init()
-        if isinstance(model, MultiLayerNetwork):
-            mtype = "MultiLayerNetwork"
-        elif isinstance(model, ComputationGraph):
-            mtype = "ComputationGraph"
+        # resolve the per-type pieces; ONE shared archive-writing block
+        if isinstance(model, TransformerLM):
+            mtype = "TransformerLM"
+            conf_json = json.dumps(model.get_config())
+            updater_tree = model.opt_state
+            net_state = None  # stateless apart from params/opt
+            iteration = model.step_count
+        elif isinstance(model, (MultiLayerNetwork, ComputationGraph)):
+            mtype = type(model).__name__
+            conf_json = model.conf.to_json()
+            updater_tree = model.updater_state
+            net_state = model.net_state
+            iteration = model.iteration_count
         else:
             raise TypeError(f"cannot serialize {type(model).__name__}")
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("configuration.json", model.conf.to_json())
+            zf.writestr("configuration.json", conf_json)
             _write_npz(zf, "coefficients.npz", model.params)
             if save_updater:
-                _write_npz(zf, "updater.npz", model.updater_state)
-            _write_npz(zf, "state.npz", model.net_state)
+                _write_npz(zf, "updater.npz", updater_tree)
+            if net_state is not None:
+                _write_npz(zf, "state.npz", net_state)
             zf.writestr(
                 "metadata.json",
                 json.dumps({
                     "format_version": ModelSerializer.FORMAT_VERSION,
                     "model_type": mtype,
-                    "iteration_count": model.iteration_count,
+                    "iteration_count": iteration,
                 }),
             )
 
@@ -140,6 +183,11 @@ class ModelSerializer:
     def restore_computation_graph(path: str, load_updater: bool = True):
         return ModelSerializer._restore(path, load_updater,
                                         expect="ComputationGraph")
+
+    @staticmethod
+    def restore_transformer_lm(path: str, load_updater: bool = True):
+        return ModelSerializer._restore(path, load_updater,
+                                        expect="TransformerLM")
 
     @staticmethod
     def restore(path: str, load_updater: bool = True):
@@ -156,14 +204,30 @@ class ModelSerializer:
         with zipfile.ZipFile(path, "r") as zf:
             meta = json.loads(zf.read("metadata.json"))
             mtype = meta.get("model_type")
-            if mtype not in ("MultiLayerNetwork", "ComputationGraph"):
+            if mtype not in ("MultiLayerNetwork", "ComputationGraph",
+                             "TransformerLM"):
                 raise ValueError(
                     f"unknown model_type {mtype!r} in checkpoint metadata")
             if expect is not None and mtype != expect:
-                other = ("restore_computation_graph" if mtype == "ComputationGraph"
-                         else "restore_multi_layer_network")
+                other = {
+                    "ComputationGraph": "restore_computation_graph",
+                    "MultiLayerNetwork": "restore_multi_layer_network",
+                    "TransformerLM": "restore_transformer_lm",
+                }[mtype]
                 raise TypeError(f"checkpoint holds a {mtype}, use {other}")
             conf_json = zf.read("configuration.json").decode()
+            if mtype == "TransformerLM":
+                from deeplearning4j_tpu.models.transformer import (
+                    TransformerLM)
+
+                lm = TransformerLM(**json.loads(conf_json)).init()
+                lm.params = _merge_into(lm.params,
+                                        _read_npz(zf, "coefficients.npz"))
+                if load_updater and "updater.npz" in zf.namelist():
+                    lm.opt_state = _merge_into(
+                        lm.opt_state, _read_npz(zf, "updater.npz"))
+                lm.step_count = meta.get("iteration_count", 0)
+                return lm
             if mtype == "MultiLayerNetwork":
                 net = MultiLayerNetwork(
                     MultiLayerConfiguration.from_json(conf_json)).init()

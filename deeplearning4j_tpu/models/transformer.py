@@ -25,7 +25,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import dtypes as dtypes_mod
-from deeplearning4j_tpu.ops.attention import dot_product_attention
+from deeplearning4j_tpu.ops.attention import (
+    dot_product_attention,
+    grouped_query_attention,
+)
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -73,7 +76,8 @@ class TransformerLM:
                  num_layers: int = 4, d_ff: Optional[int] = None,
                  max_len: int = 512, lr: float = 3e-4, seed: int = 0,
                  dtype_policy: str = "float32", attn_impl: str = "auto",
-                 remat: bool = False, pos_encoding: str = "learned"):
+                 remat: bool = False, pos_encoding: str = "learned",
+                 num_kv_heads: Optional[int] = None):
         assert d_model % num_heads == 0
         # "auto": Pallas flash kernel when a TPU backend is attached and
         # head_dim maps onto lane tiles; "xla" / "flash" force a path
@@ -89,6 +93,14 @@ class TransformerLM:
                 f"{d_model // num_heads}: d_model={d_model} / "
                 f"num_heads={num_heads}); the rotation pairs dimensions")
         self.pos_encoding = pos_encoding
+        # GQA/MQA: fewer key/value heads than query heads — KV cache and
+        # wk/wv params shrink by num_heads/num_kv_heads; K/V are repeated
+        # across each query-head group at attention time
+        self.num_kv_heads = num_heads if num_kv_heads is None else num_kv_heads
+        if self.num_kv_heads < 1 or num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_kv_heads={self.num_kv_heads} must be >= 1 and divide "
+                f"num_heads={num_heads}")
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) instead of keeping them live across the whole
         # step — trades ~1/3 more FLOPs for O(sqrt) activation memory, the
@@ -112,6 +124,7 @@ class TransformerLM:
     def init(self) -> "TransformerLM":
         key = jax.random.PRNGKey(self.seed)
         D, F, V, L = self.d_model, self.d_ff, self.vocab_size, self.max_len
+        Dh = D // self.num_heads
         dt = self.policy.param_dtype
 
         def dense(key, fan_in, fan_out):
@@ -131,8 +144,10 @@ class TransformerLM:
             params["blocks"].append({
                 "ln1": {"g": jnp.ones((D,), dt), "b": jnp.zeros((D,), dt)},
                 "attn": {
-                    "wq": dense(k[0], D, D), "wk": dense(k[1], D, D),
-                    "wv": dense(k[2], D, D), "wo": dense(k[3], D, D),
+                    "wq": dense(k[0], D, D),
+                    "wk": dense(k[1], D, self.num_kv_heads * Dh),
+                    "wv": dense(k[2], D, self.num_kv_heads * Dh),
+                    "wo": dense(k[3], D, D),
                 },
                 "ln2": {"g": jnp.ones((D,), dt), "b": jnp.zeros((D,), dt)},
                 "mlp": {
@@ -175,23 +190,29 @@ class TransformerLM:
         q = (x @ policy.cast_compute(blk["attn"]["wq"])).reshape(
             b, t, self.num_heads, -1)
         k = (x @ policy.cast_compute(blk["attn"]["wk"])).reshape(
-            b, t, self.num_heads, -1)
+            b, t, self.num_kv_heads, -1)
         v = (x @ policy.cast_compute(blk["attn"]["wv"])).reshape(
-            b, t, self.num_heads, -1)
+            b, t, self.num_kv_heads, -1)
         if self.pos_encoding == "rope":
             if positions is None:
                 positions = jnp.arange(t)
             q = _rope(q, positions)
             k = _rope(k, positions)
+        # the returned k/v stay at num_kv_heads (what the KV cache
+        # stores); attention sees them repeated per query-head group
         if attention is not None:
             o = attention(q, k, v)
         elif sequence_parallel and mesh is not None:
-            o = ring_attention(q, k, v, mesh, causal=True,
-                               impl=self._attn_impl(t))
+            o = ring_attention(q, self._repeat_kv(k), self._repeat_kv(v),
+                               mesh, causal=True, impl=self._attn_impl(t))
         elif self._attn_impl(t) == "flash":
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, self._repeat_kv(k), self._repeat_kv(v),
+                                causal=True)
         else:
-            o = dot_product_attention(q, k, v, causal=True)
+            # grouped attention broadcasts each kv head over its query
+            # group — no materialized repeat (= dot_product_attention
+            # when H == Hkv)
+            o = grouped_query_attention(q, k, v, causal=True)
         h = h + o.reshape(b, t, -1) @ policy.cast_compute(blk["attn"]["wo"])
         x = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
         x = jax.nn.gelu(x @ policy.cast_compute(blk["mlp"]["w1"])
@@ -199,6 +220,12 @@ class TransformerLM:
         h = (h + x @ policy.cast_compute(blk["mlp"]["w2"])
              + policy.cast_compute(blk["mlp"]["b2"]))
         return h, k, v
+
+    def _repeat_kv(self, x):
+        """[b, t, Hkv, d] → [b, t, H, d] by repeating each kv head over
+        its query-head group (no-op when H == Hkv)."""
+        rep = self.num_heads // self.num_kv_heads
+        return x if rep == 1 else jnp.repeat(x, rep, axis=2)
 
     def forward(self, params, tokens, *, mesh: Optional[Mesh] = None,
                 sequence_parallel: bool = False):
@@ -325,6 +352,7 @@ class TransformerLM:
         return {
             "vocab_size": self.vocab_size, "d_model": self.d_model,
             "num_heads": self.num_heads, "num_layers": self.num_layers,
+            "num_kv_heads": self.num_kv_heads,
             "d_ff": self.d_ff, "max_len": self.max_len, "lr": self.lr,
             "seed": self.seed, "dtype_policy": self.dtype_policy_name,
             "attn_impl": self.attn_impl, "remat": self.remat,
@@ -407,7 +435,7 @@ class TransformerLM:
                 cv = lax.dynamic_update_slice(
                     c["v"], vv.astype(cdt), (0, t, 0, 0))
                 new_cache.append({"k": ck, "v": cv})
-                return dot_product_attention(
+                return grouped_query_attention(
                     q, ck, cv, mask=jnp.broadcast_to(live, (B, total)))
             return attn
 
@@ -582,14 +610,22 @@ class TransformerLM:
     # ------------------------------------------------------------------
     # tensor-parallel sharding specs (Megatron split)
     # ------------------------------------------------------------------
-    def param_specs(self, *, shard_data_embed: bool = False) -> Dict[str, Any]:
+    def param_specs(self, *, shard_data_embed: bool = False,
+                    model_axis_size: Optional[int] = None) -> Dict[str, Any]:
         col = P(None, MODEL_AXIS)
         row = P(MODEL_AXIS, None)
+        # the Megatron split shards whole heads per device; with GQA the
+        # kv heads must tile the model axis or shards cut inside a head
+        # and K/V regather defeats the split — replicate wk/wv then
+        # (pass model_axis_size, as shard_params does, to enable this)
+        kv_col = col
+        if model_axis_size and self.num_kv_heads % model_axis_size:
+            kv_col = P()
         blocks = []
         for _ in range(self.num_layers):
             blocks.append({
                 "ln1": {"g": P(), "b": P()},
-                "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+                "attn": {"wq": col, "wk": kv_col, "wv": kv_col, "wo": row},
                 "ln2": {"g": P(), "b": P()},
                 "mlp": {"w1": col, "b1": P(MODEL_AXIS), "w2": row, "b2": P()},
             })
@@ -607,7 +643,8 @@ class TransformerLM:
 
         PartitionSpec is a tuple subclass, so tree_map would descend into it;
         flatten the params treedef and match specs leaf-for-leaf instead."""
-        specs = specs or self.param_specs()
+        specs = specs or self.param_specs(
+            model_axis_size=dict(mesh.shape).get(MODEL_AXIS, 1))
         flat_p, treedef = jax.tree_util.tree_flatten(self.params)
         flat_spec = treedef.flatten_up_to(specs)
         self.params = jax.tree_util.tree_unflatten(treedef, [

@@ -61,6 +61,47 @@ def dot_product_attention(
                       preferred_element_type=jnp.float32).astype(v.dtype)
 
 
+def grouped_query_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,  # [b, t_kv] padding mask (1=keep)
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """GQA/MQA attention: q [b, tq, H, d] against k/v [b, tkv, Hkv, d]
+    with H a multiple of Hkv. Each kv head serves a GROUP of query heads
+    via broadcasting — the repeated K/V is never materialized (the whole
+    point of GQA's decode-bandwidth saving). Same numerics/masking as
+    :func:`dot_product_attention`; delegates to it when H == Hkv."""
+    b, tq, H, d = q.shape
+    hkv = k.shape[2]
+    if H == hkv:
+        return dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                     scale=scale)
+    if H % hkv:
+        raise ValueError(f"num query heads {H} not a multiple of kv "
+                         f"heads {hkv}")
+    rep = H // hkv
+    scale = scale if scale is not None else float(1.0 / np.sqrt(d))
+    qg = q.reshape(b, tq, hkv, rep, d)
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tkv = k.shape[1]
+        qi = jnp.arange(tq)[:, None]
+        ki = jnp.arange(tkv)[None, :]
+        logits = jnp.where(qi >= ki, logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, None, :].astype(bool),
+                           logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bhrqk,bkhd->bqhrd", weights.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32).astype(v.dtype)
+    return o.reshape(b, tq, H, d)
+
+
 def multi_head_attention(
     x: jnp.ndarray,
     wq: jnp.ndarray,

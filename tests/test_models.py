@@ -436,3 +436,62 @@ class TestRoPE:
                                 num_layers=1, max_len=8, seed=0).init()
         with _pytest.raises(ValueError, match="learned position table"):
             learned.generate(prompt, max_new_tokens=6)
+
+
+class TestGQA:
+    def test_gqa_shapes_and_param_savings(self):
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        full = TransformerLM(vocab_size=32, d_model=64, num_heads=8,
+                             num_layers=1, max_len=16, seed=0).init()
+        gqa = TransformerLM(vocab_size=32, d_model=64, num_heads=8,
+                            num_layers=1, max_len=16, seed=0,
+                            num_kv_heads=2).init()
+        assert gqa.params["blocks"][0]["attn"]["wk"].shape == (64, 16)
+        assert full.params["blocks"][0]["attn"]["wk"].shape == (64, 64)
+
+    def test_gqa_trains_and_cache_decode_matches_naive(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        period = 8
+        lm = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                           num_layers=2, max_len=32, lr=5e-3, seed=0,
+                           num_kv_heads=1, pos_encoding="rope").init()
+        tok = jnp.asarray(np.tile(np.arange(period), (8, 4))[:, :32],
+                          jnp.int32)
+        step = lm.make_train_step()
+        first = lm.fit_batch(tok, train_step=step)
+        for _ in range(150):
+            last = lm.fit_batch(tok, train_step=step)
+        assert last < first * 0.2
+        prompt = jnp.asarray(
+            np.tile(np.arange(period), (1, 2))[:, :12], jnp.int32)
+        out = lm.generate(prompt, max_new_tokens=8)
+        seq = prompt
+        for _ in range(8):
+            nxt = jnp.argmax(lm.forward(lm.params, seq)[:, -1],
+                             -1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+        assert np.asarray(out)[0, 12:].tolist() == [
+            (12 + i) % period for i in range(8)]
+
+    def test_gqa_guard_and_serialization(self):
+        import tempfile
+
+        import pytest as _pytest
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+        for bad in (3, 0, -2):
+            with _pytest.raises(ValueError, match="num_kv_heads"):
+                TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                              num_kv_heads=bad)
+        lm = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                           num_layers=1, max_len=8, seed=0,
+                           num_kv_heads=2).init()
+        with tempfile.TemporaryDirectory() as d:
+            ModelSerializer.write_model(lm, f"{d}/g.zip")
+            back = ModelSerializer.restore(f"{d}/g.zip")
+        assert back.num_kv_heads == 2

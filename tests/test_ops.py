@@ -125,3 +125,39 @@ class TestInitializers:
         w1 = init_weights(jax.random.PRNGKey(7), (3, 3), "XAVIER")
         w2 = init_weights(jax.random.PRNGKey(7), (3, 3), "XAVIER")
         np.testing.assert_array_equal(w1, w2)
+
+
+class TestGroupedQueryAttention:
+    def test_matches_repeated_dot_product_attention(self):
+        """GQA must equal attention with K/V explicitly repeated over
+        each query-head group, for every mask combination."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.attention import (
+            dot_product_attention, grouped_query_attention)
+
+        rng = np.random.default_rng(0)
+        b, tq, tkv, H, hkv, d = 2, 8, 12, 6, 2, 16
+        q = jnp.asarray(rng.normal(size=(b, tq, H, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, tkv, hkv, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, tkv, hkv, d)), jnp.float32)
+        mask = jnp.asarray(rng.integers(0, 2, (b, tkv)), jnp.float32)
+        mask = mask.at[:, 0].set(1.0)  # no fully-masked rows
+        kr = jnp.repeat(k, H // hkv, axis=2)
+        vr = jnp.repeat(v, H // hkv, axis=2)
+        for kwargs in ({}, {"causal": True}, {"mask": mask},
+                       {"causal": True, "mask": mask}):
+            ref = dot_product_attention(q, kr, vr, **kwargs)
+            got = grouped_query_attention(q, k, v, **kwargs)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-6, atol=2e-6)
+
+    def test_head_count_guard_and_delegation(self):
+        import pytest as _pytest
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.attention import (
+            grouped_query_attention)
+
+        q = jnp.ones((1, 4, 6, 8))
+        kv = jnp.ones((1, 4, 4, 8))
+        with _pytest.raises(ValueError, match="not a multiple"):
+            grouped_query_attention(q, kv, kv)

@@ -77,7 +77,8 @@ class TransformerLM:
                  max_len: int = 512, lr: float = 3e-4, seed: int = 0,
                  dtype_policy: str = "float32", attn_impl: str = "auto",
                  remat: bool = False, pos_encoding: str = "learned",
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 attn_window: Optional[int] = None):
         assert d_model % num_heads == 0
         # "auto": Pallas flash kernel when a TPU backend is attached and
         # head_dim maps onto lane tiles; "xla" / "flash" force a path
@@ -101,6 +102,12 @@ class TransformerLM:
             raise ValueError(
                 f"num_kv_heads={self.num_kv_heads} must be >= 1 and divide "
                 f"num_heads={num_heads}")
+        # sliding-window local attention: each query sees only the last
+        # attn_window keys (None = full causal attention); composes with
+        # the XLA, grouped, and flash paths (NOT ring)
+        if attn_window is not None and attn_window < 1:
+            raise ValueError(f"attn_window={attn_window} must be >= 1")
+        self.attn_window = attn_window
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) instead of keeping them live across the whole
         # step — trades ~1/3 more FLOPs for O(sqrt) activation memory, the
@@ -203,16 +210,21 @@ class TransformerLM:
         if attention is not None:
             o = attention(q, k, v)
         elif sequence_parallel and mesh is not None:
+            if self.attn_window is not None:
+                raise NotImplementedError(
+                    "attn_window is not supported with sequence-parallel "
+                    "ring attention")
             o = ring_attention(q, self._repeat_kv(k), self._repeat_kv(v),
                                mesh, causal=True, impl=self._attn_impl(t))
         elif self._attn_impl(t) == "flash":
             o = flash_attention(q, self._repeat_kv(k), self._repeat_kv(v),
-                                causal=True)
+                                causal=True, window=self.attn_window)
         else:
             # grouped attention broadcasts each kv head over its query
             # group — no materialized repeat (= dot_product_attention
             # when H == Hkv)
-            o = grouped_query_attention(q, k, v, causal=True)
+            o = grouped_query_attention(q, k, v, causal=True,
+                                        window=self.attn_window)
         h = h + o.reshape(b, t, -1) @ policy.cast_compute(blk["attn"]["wo"])
         x = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
         x = jax.nn.gelu(x @ policy.cast_compute(blk["mlp"]["w1"])
@@ -353,6 +365,7 @@ class TransformerLM:
             "vocab_size": self.vocab_size, "d_model": self.d_model,
             "num_heads": self.num_heads, "num_layers": self.num_layers,
             "num_kv_heads": self.num_kv_heads,
+            "attn_window": self.attn_window,
             "d_ff": self.d_ff, "max_len": self.max_len, "lr": self.lr,
             "seed": self.seed, "dtype_policy": self.dtype_policy_name,
             "attn_impl": self.attn_impl, "remat": self.remat,
@@ -425,7 +438,10 @@ class TransformerLM:
         if self.pos_encoding == "learned":
             h = h + params["pos"][t]
         h = policy.cast_compute(h)[:, None, :]              # [B, 1, D]
-        live = (jnp.arange(total) <= t)[None, :]            # [1, total]
+        live = jnp.arange(total) <= t                       # [total]
+        if self.attn_window is not None:
+            live &= jnp.arange(total) > t - self.attn_window
+        live = live[None, :]                                # [1, total]
         new_cache = []
 
         def cached_attention(c):

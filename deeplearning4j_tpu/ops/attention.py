@@ -33,11 +33,16 @@ def dot_product_attention(
     mask: Optional[jnp.ndarray] = None,  # [b, t_kv] padding mask (1=keep)
     bias: Optional[jnp.ndarray] = None,  # [b, h, t_q, t_kv] additive
     scale: Optional[float] = None,
+    window: Optional[int] = None,  # sliding window: k in (q-window, q]
 ) -> jnp.ndarray:
     """Reference (non-blockwise) attention: softmax(q·kᵀ/√d + bias)·v.
 
-    q: [b, tq, h, d]; k/v: [b, tkv, h, d] → [b, tq, h, d].
+    q: [b, tq, h, d]; k/v: [b, tkv, h, d] → [b, tq, h, d]. ``window``
+    (requires ``causal``) limits each query to the last ``window`` keys
+    — sliding-window local attention.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     d = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / np.sqrt(d))
     # bf16 inputs feed the MXU; logits accumulate in f32
@@ -51,7 +56,10 @@ def dot_product_attention(
         # allow tq != tkv (e.g. blockwise): positions are absolute offsets
         qi = jnp.arange(tq)[:, None]
         ki = jnp.arange(tkv)[None, :]
-        logits = jnp.where(qi >= ki, logits, NEG_INF)
+        keep = qi >= ki
+        if window is not None:
+            keep &= qi - ki < window
+        logits = jnp.where(keep, logits, NEG_INF)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :].astype(bool), logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
@@ -69,17 +77,20 @@ def grouped_query_attention(
     causal: bool = False,
     mask: Optional[jnp.ndarray] = None,  # [b, t_kv] padding mask (1=keep)
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """GQA/MQA attention: q [b, tq, H, d] against k/v [b, tkv, Hkv, d]
     with H a multiple of Hkv. Each kv head serves a GROUP of query heads
     via broadcasting — the repeated K/V is never materialized (the whole
     point of GQA's decode-bandwidth saving). Same numerics/masking as
     :func:`dot_product_attention`; delegates to it when H == Hkv."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, tq, H, d = q.shape
     hkv = k.shape[2]
     if H == hkv:
         return dot_product_attention(q, k, v, causal=causal, mask=mask,
-                                     scale=scale)
+                                     scale=scale, window=window)
     if H % hkv:
         raise ValueError(f"num query heads {H} not a multiple of kv "
                          f"heads {hkv}")
@@ -92,7 +103,10 @@ def grouped_query_attention(
         tkv = k.shape[1]
         qi = jnp.arange(tq)[:, None]
         ki = jnp.arange(tkv)[None, :]
-        logits = jnp.where(qi >= ki, logits, NEG_INF)
+        keep = qi >= ki
+        if window is not None:
+            keep &= qi - ki < window
+        logits = jnp.where(keep, logits, NEG_INF)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, None, :].astype(bool),
                            logits, NEG_INF)

@@ -47,7 +47,7 @@ def flash_default_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, n_k, kv_len):
+                *, scale, causal, block_q, block_k, n_k, kv_len, window):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -69,6 +69,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         mask = k_pos < kv_len  # kv padding
         if causal:
             mask &= q_pos >= k_pos
+        if window is not None:
+            mask &= q_pos - k_pos < window
         s = jnp.where(mask, s, MASK_VALUE)
 
         m_prev = m_ref[...]                              # [block_q, LANES]
@@ -86,13 +88,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         acc_ref[...] = acc_ref[...] * corr[:, :1] + pv
         m_ref[...] = m_next
 
-    if causal:
-        # skip key blocks strictly above the diagonal
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
-        def _():
-            _compute()
-    else:
-        _compute()
+    _when_block_in_band(causal, qi, ki, block_q, block_k, window, _compute)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -102,6 +98,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         # lse replicated across the lane dim (TPU block tiling needs a
         # 128-wide last axis; the wrapper slices lane 0)
         lse_ref[0] = m_ref[...] + jnp.log(safe_l)
+
+
+def _when_block_in_band(causal, qi, ki, block_q, block_k, window, fn):
+    """Run ``fn`` unless the whole tile is dead: above the causal
+    diagonal, or (sliding window) entirely below the band."""
+    cond = None
+    if causal:
+        cond = qi * block_q + block_q - 1 >= ki * block_k
+    if window is not None:
+        below = ki * block_k + block_k - 1 >= qi * block_q - window + 1
+        cond = below if cond is None else cond & below
+    if cond is None:
+        fn()
+    else:
+        @pl.when(cond)
+        def _():
+            fn()
 
 
 def _round128(t: int) -> int:
@@ -135,8 +148,11 @@ def flash_attention_fwd(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Kernel launch. q: [b, tq, h, d]; k/v: [b, tkv, h, d].
+    ``window`` (requires ``causal``) keeps k in (q-window, q] —
+    sliding-window local attention; out-of-band tiles are skipped.
 
     Returns ``(out [b, tq, h, d], lse [b, h, tq])`` with no autodiff rule —
     use :func:`flash_attention` for training. ``causal`` assumes q and k
@@ -146,6 +162,8 @@ def flash_attention_fwd(
     """
     if interpret is None:
         interpret = flash_default_interpret()
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, tq, h, d = q.shape
     tkv = k.shape[1]
     if causal and tq != tkv:
@@ -168,7 +186,8 @@ def flash_attention_fwd(
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale_val, causal=causal,
-        block_q=block_q, block_k=block_k, n_k=n_k, kv_len=tkv)
+        block_q=block_q, block_k=block_k, n_k=n_k, kv_len=tkv,
+        window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
@@ -205,7 +224,7 @@ def flash_attention_fwd(
 
 def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
                    scale: Optional[float] = None, block_k: int = 1024,
-                   q_offset=0, k_offset=0):
+                   q_offset=0, k_offset=0, window: Optional[int] = None):
     """Chunked flash backward (XLA scan). The production paths use the
     Pallas kernels (:func:`flash_backward_pallas`, used by both the
     custom_vjp and the ring backward); this scan version remains as the
@@ -218,6 +237,8 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     q/out/do: [b, tq, h, d]; k/v: [b, tkv, h, d]; lse: [b, h, tq].
     Returns (dq, dk, dv) in the input layouts (float32).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, tq, h, d = q.shape
     tkv = k.shape[1]
     block_k = min(block_k, _round128(tkv))
@@ -248,6 +269,8 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
         valid = (k_pos < k_offset + tkv)[None, :]
         if causal:
             valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        if window is not None:
+            valid = valid & (q_pos[:, None] - k_pos[None, :] < window)
         s = jnp.where(valid[None, None], s, MASK_VALUE)
         p = jnp.exp(s - lse[..., None])          # [b, h, tq, block_k] f32
         p = jnp.where(valid[None, None], p, 0.0)
@@ -268,7 +291,8 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
 
 
 def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
-              qi, ki, scale, causal, block_q, block_k, q_len, kv_len):
+              qi, ki, scale, causal, block_q, block_k, q_len, kv_len,
+              window):
     """Shared backward tile math, kv-major ([block_k, block_q]) so the
     per-query lse/delta broadcast along lanes — no sublane transposes.
     Returns ``(p, ds)`` in f32; the score tile never leaves VMEM."""
@@ -287,6 +311,8 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     valid = (q_pos < q_len) & (k_pos < kv_len)
     if causal:
         valid &= q_pos >= k_pos
+    if window is not None:
+        valid &= q_pos - k_pos < window
     s = jnp.where(valid, s, MASK_VALUE)
     # masked entries: exp(MASK - lse) == 0 for any finite lse (padded
     # query rows pad lse with 0), so no post-exp zeroing is needed
@@ -297,20 +323,9 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     return p, ds
 
 
-def _when_block_visible(causal, qi, ki, block_q, block_k, fn):
-    """Run ``fn`` unless causal masking makes the whole tile dead
-    (query block strictly above the diagonal)."""
-    if causal:
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
-        def _():
-            fn()
-    else:
-        fn()
-
-
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                     block_q, block_k, n_q, q_len, kv_len):
+                     block_q, block_k, n_q, q_len, kv_len, window):
     """dk/dv for one key block, scanning query blocks."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -324,7 +339,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           qi=qi, ki=ki, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          q_len=q_len, kv_len=kv_len)
+                          q_len=q_len, kv_len=kv_len, window=window)
         q, do = q_ref[0], do_ref[0]
         dv_acc[...] += lax.dot_general(
             p.astype(do.dtype), do, (((1,), (0,)), ((), ())),
@@ -333,7 +348,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    _when_block_visible(causal, qi, ki, block_q, block_k, _compute)
+    _when_block_in_band(causal, qi, ki, block_q, block_k, window,
+                        _compute)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -343,7 +359,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                   n_k, q_len, kv_len):
+                   n_k, q_len, kv_len, window):
     """dq for one query block, scanning key blocks (kv-major tiles)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -356,14 +372,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           qi=qi, ki=ki, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          q_len=q_len, kv_len=kv_len)
+                          q_len=q_len, kv_len=kv_len, window=window)
         k = k_ref[0]
         # contract over the key dim (sublanes): [bk, bq]^T x [bk, d]
         dq_acc[...] += lax.dot_general(
             ds.astype(k.dtype), k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    _when_block_visible(causal, qi, ki, block_q, block_k, _compute)
+    _when_block_in_band(causal, qi, ki, block_q, block_k, window,
+                        _compute)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -373,7 +390,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
                           scale: Optional[float] = None, block_q: int = 512,
                           block_k: int = 512,
-                          interpret: Optional[bool] = None):
+                          interpret: Optional[bool] = None,
+                          window: Optional[int] = None):
     """Pallas flash backward: the score/probability tiles stay in VMEM
     (two kernels: dk/dv over key blocks, dq over query blocks), unlike
     :func:`flash_backward` whose XLA scan round-trips O(t·block) f32
@@ -385,6 +403,8 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
     """
     if interpret is None:
         interpret = flash_default_interpret()
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, tq, h, d = q.shape
     tkv = k.shape[1]
     block_q = min(block_q, _round128(tq))
@@ -411,7 +431,7 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
 
     common = dict(scale=scale_val, causal=causal,
                   block_q=block_q, block_k=block_k,
-                  q_len=tq, kv_len=tkv)
+                  q_len=tq, kv_len=tkv, window=window)
 
     def specs(q_idx, k_idx):
         """Input specs for a (bh, i, j) grid; q/do/lse/delta blocks follow
@@ -477,18 +497,21 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
 class _FlashConfig:
     """Hashable static config for the custom_vjp nondiff argument."""
 
-    __slots__ = ("causal", "scale", "block_q", "block_k", "interpret")
+    __slots__ = ("causal", "scale", "block_q", "block_k", "interpret",
+                 "window")
 
-    def __init__(self, causal, scale, block_q, block_k, interpret):
+    def __init__(self, causal, scale, block_q, block_k, interpret,
+                 window=None):
         self.causal = causal
         self.scale = scale
         self.block_q = block_q
         self.block_k = block_k
         self.interpret = interpret
+        self.window = window
 
     def _key(self):
         return (self.causal, self.scale, self.block_q, self.block_k,
-                self.interpret)
+                self.interpret, self.window)
 
     def __hash__(self):
         return hash(self._key())
@@ -502,14 +525,14 @@ class _FlashConfig:
 def _flash(cfg: _FlashConfig, q, k, v):
     out, _ = flash_attention_fwd(
         q, k, v, causal=cfg.causal, scale=cfg.scale, block_q=cfg.block_q,
-        block_k=cfg.block_k, interpret=cfg.interpret)
+        block_k=cfg.block_k, interpret=cfg.interpret, window=cfg.window)
     return out
 
 
 def _flash_fwd_rule(cfg, q, k, v):
     out, lse = flash_attention_fwd(
         q, k, v, causal=cfg.causal, scale=cfg.scale, block_q=cfg.block_q,
-        block_k=cfg.block_k, interpret=cfg.interpret)
+        block_k=cfg.block_k, interpret=cfg.interpret, window=cfg.window)
     return out, (q, k, v, out, lse)
 
 
@@ -517,7 +540,8 @@ def _flash_bwd_rule(cfg, res, do):
     q, k, v, out, lse = res
     dq, dk, dv = flash_backward_pallas(
         q, k, v, out, lse, do, causal=cfg.causal, scale=cfg.scale,
-        block_q=cfg.block_q, block_k=cfg.block_k, interpret=cfg.interpret)
+        block_q=cfg.block_q, block_k=cfg.block_k, interpret=cfg.interpret,
+        window=cfg.window)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -534,6 +558,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Differentiable flash attention. q: [b, tq, h, d] → [b, tq, h, d].
 
@@ -543,5 +568,5 @@ def flash_attention(
     """
     if interpret is None:
         interpret = flash_default_interpret()
-    cfg = _FlashConfig(causal, scale, block_q, block_k, interpret)
+    cfg = _FlashConfig(causal, scale, block_q, block_k, interpret, window)
     return _flash(cfg, q, k, v)

@@ -495,3 +495,54 @@ class TestGQA:
             ModelSerializer.write_model(lm, f"{d}/g.zip")
             back = ModelSerializer.restore(f"{d}/g.zip")
         assert back.num_kv_heads == 2
+
+
+class TestSlidingWindowLM:
+    def test_windowed_lm_trains_and_decode_matches_naive(self):
+        """attn_window LM: the decode step's banded live-mask must equal
+        the training-path band — greedy cache decode == naive
+        full-forward decode."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        period = 8
+        lm = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                           num_layers=2, max_len=32, lr=5e-3, seed=0,
+                           pos_encoding="rope", attn_window=8).init()
+        tok = jnp.asarray(np.tile(np.arange(period), (8, 4))[:, :32],
+                          jnp.int32)
+        step = lm.make_train_step()
+        first = lm.fit_batch(tok, train_step=step)
+        for _ in range(150):
+            last = lm.fit_batch(tok, train_step=step)
+        assert last < first * 0.2
+        prompt = jnp.asarray(
+            np.tile(np.arange(period), (1, 2))[:, :12], jnp.int32)
+        out = lm.generate(prompt, max_new_tokens=8)
+        seq = prompt
+        for _ in range(8):
+            nxt = jnp.argmax(lm.forward(lm.params, seq)[:, -1],
+                             -1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+        assert np.asarray(out)[0, 12:].tolist() == [
+            (12 + i) % period for i in range(8)]
+
+    def test_window_guards(self):
+        import pytest as _pytest
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        with _pytest.raises(ValueError, match="attn_window"):
+            TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                          attn_window=0)
+        lm = TransformerLM(vocab_size=16, d_model=32, num_heads=4,
+                           num_layers=1, max_len=16, seed=0,
+                           attn_window=4).init()
+        import numpy as _np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.parallel import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(data=4, sequence=2))
+        tok = jnp.asarray(_np.zeros((2, 8), _np.int32))
+        with _pytest.raises(NotImplementedError, match="ring"):
+            lm.loss(lm.params, tok, mesh=mesh, sequence_parallel=True)

@@ -241,3 +241,93 @@ class TestFlashBackwardOffsets:
         np.testing.assert_allclose(np.asarray(dv0 + dv1),
                                    np.asarray(dv_full),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestSlidingWindow:
+    @pytest.mark.parametrize("t,window", [(128, 32), (200, 50), (128, 128)])
+    def test_flash_window_matches_reference(self, t, window):
+        from deeplearning4j_tpu.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(2, t, 2, 32, seed=20)
+        ref = dot_product_attention(q, k, v, causal=True, window=window)
+        out = flash_attention(q, k, v, causal=True, window=window,
+                              block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_flash_window_grads_match_reference(self):
+        from deeplearning4j_tpu.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(1, 128, 2, 32, seed=21)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, k, v, causal=True, window=48) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, window=48, block_q=64,
+                block_k=64) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_window_requires_causal(self):
+        from deeplearning4j_tpu.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(1, 64, 2, 32)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, window=16)
+        with pytest.raises(ValueError, match="causal"):
+            dot_product_attention(q, k, v, window=16)
+
+    def test_grouped_window_matches_repeat(self):
+        from deeplearning4j_tpu.ops.attention import grouped_query_attention
+
+        rng = np.random.default_rng(22)
+        q = jnp.asarray(rng.normal(size=(1, 32, 4, 16)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 32, 2, 16)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 32, 2, 16)), jnp.float32)
+        ref = dot_product_attention(q, jnp.repeat(k, 2, 2),
+                                    jnp.repeat(v, 2, 2),
+                                    causal=True, window=8)
+        got = grouped_query_attention(q, k, v, causal=True, window=8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_window_grads_with_fully_dead_tiles(self):
+        """t=192, window=32, block 64: query block 2 never intersects key
+        block 0, so the BACKWARD kernels' band skip runs in its dead
+        state — a wrong skip condition would zero live dk/dv tiles."""
+        from deeplearning4j_tpu.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(1, 192, 2, 32, seed=23)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, k, v, causal=True, window=32) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, window=32, block_q=64,
+                block_k=64) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_backward_entry_points_validate_window(self):
+        from deeplearning4j_tpu.pallas.flash_attention import (
+            flash_attention_fwd, flash_backward, flash_backward_pallas)
+
+        q, k, v = _qkv(1, 64, 2, 32)
+        out, lse = flash_attention_fwd(q, k, v, causal=True,
+                                       block_q=64, block_k=64)
+        for fn in (flash_backward, flash_backward_pallas):
+            with pytest.raises(ValueError, match="causal"):
+                fn(q, k, v, out, lse, q, causal=False, window=16)

@@ -41,8 +41,8 @@ def dot_product_attention(
     (requires ``causal``) limits each query to the last ``window`` keys
     — sliding-window local attention.
     """
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     d = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / np.sqrt(d))
     # bf16 inputs feed the MXU; logits accumulate in f32
@@ -84,8 +84,8 @@ def grouped_query_attention(
     via broadcasting — the repeated K/V is never materialized (the whole
     point of GQA's decode-bandwidth saving). Same numerics/masking as
     :func:`dot_product_attention`; delegates to it when H == Hkv."""
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     b, tq, H, d = q.shape
     hkv = k.shape[2]
     if H == hkv:

@@ -152,7 +152,11 @@ def flash_attention_fwd(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Kernel launch. q: [b, tq, h, d]; k/v: [b, tkv, h, d].
     ``window`` (requires ``causal``) keeps k in (q-window, q] —
-    sliding-window local attention; out-of-band tiles are skipped.
+    sliding-window local attention. Out-of-band tiles skip their MXU
+    math (``_when_block_in_band``) but the grid still visits and DMAs
+    every K/V block, so HBM traffic stays O(t²); an O(t·window) banded
+    grid (index_map as a function of qi and window) is the known
+    follow-up for long-t windowed configs.
 
     Returns ``(out [b, tq, h, d], lse [b, h, tq])`` with no autodiff rule —
     use :func:`flash_attention` for training. ``causal`` assumes q and k
@@ -162,8 +166,8 @@ def flash_attention_fwd(
     """
     if interpret is None:
         interpret = flash_default_interpret()
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     b, tq, h, d = q.shape
     tkv = k.shape[1]
     if causal and tq != tkv:
@@ -237,8 +241,8 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     q/out/do: [b, tq, h, d]; k/v: [b, tkv, h, d]; lse: [b, h, tq].
     Returns (dq, dk, dv) in the input layouts (float32).
     """
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     b, tq, h, d = q.shape
     tkv = k.shape[1]
     block_k = min(block_k, _round128(tkv))
@@ -403,8 +407,8 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
     """
     if interpret is None:
         interpret = flash_default_interpret()
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     b, tq, h, d = q.shape
     tkv = k.shape[1]
     block_q = min(block_q, _round128(tq))

@@ -57,25 +57,36 @@ def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     return out
 
 
-def _listify(node: Any) -> Any:
-    """Convert dict nodes whose keys are all '[N]' back into lists."""
+def _listify_and_unescape(node: Any) -> Any:
+    """Convert dict nodes whose (ESCAPED) keys are all '[N]' back into
+    lists, then unescape the remaining dict keys. Working in escaped
+    space makes list markers unambiguous: _escape maps '[' to '%5B', so
+    a user dict key literally named '[0]' can never look like a list
+    index here."""
     if not isinstance(node, dict):
         return node
-    out = {k: _listify(v) for k, v in node.items()}
-    if out and all(k.startswith("[") and k.endswith("]") for k in out):
-        return [out[f"[{i}]"] for i in range(len(out))]
-    return out
+    if node and all(k.startswith("[") and k.endswith("]") for k in node):
+        try:
+            return [_listify_and_unescape(node[f"[{i}]"])
+                    for i in range(len(node))]
+        except KeyError:
+            raise ValueError(
+                f"corrupt archive: list entries {sorted(node)} are not "
+                f"contiguous [0..{len(node) - 1}] indices") from None
+    return {_unescape(k): _listify_and_unescape(v)
+            for k, v in node.items()}
 
 
 def _unflatten_tree(flat: Dict[str, np.ndarray]) -> Any:
     root: Dict[str, Any] = {}
     for key, value in flat.items():
-        parts = [_unescape(p) for p in key.split("/")]
+        # components stay ESCAPED until _listify_and_unescape
+        parts = key.split("/")
         node = root
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         node[parts[-1]] = jnp.asarray(value)
-    return _listify(root)
+    return _listify_and_unescape(root)
 
 
 def _write_npz(zf: zipfile.ZipFile, name: str, tree: Any) -> None:

@@ -247,3 +247,56 @@ class TestTransformerLMSerialization:
                                       tree["[0]"]["W"])
         np.testing.assert_array_equal(np.asarray(back["blocks"][1]["W"]),
                                       tree["blocks"][1]["W"])
+
+
+class TestTreeCodecFuzz:
+    def test_randomized_tree_round_trip(self):
+        """200 seeded random pytrees (nested dicts/lists, adversarial key
+        names incl. '/', '%', '[i]' shapes) must round-trip through the
+        flatten/npz/unflatten codec exactly."""
+        import io
+        import zipfile
+
+        from deeplearning4j_tpu.utils.serializer import (
+            _read_npz, _write_npz)
+
+        keys = ["W", "b", "0_W", "a/b", "%2F", "[0]", "[x]", "blocks",
+                "m", "layer.1", "%"]
+
+        def rand_tree(rng, depth):
+            kind = rng.integers(0, 3 if depth < 3 else 1)
+            if kind == 0 or depth >= 3:
+                shape = tuple(rng.integers(1, 4, rng.integers(0, 3)))
+                return rng.normal(size=shape).astype(np.float32)
+            if kind == 1:
+                n = int(rng.integers(1, 4))
+                picked = rng.choice(len(keys), size=n, replace=False)
+                return {keys[i]: rand_tree(rng, depth + 1) for i in picked}
+            return [rand_tree(rng, depth + 1)
+                    for _ in range(int(rng.integers(1, 4)))]
+
+        def assert_same(a, b, path=""):
+            assert type(a) in (dict, list) and type(b) is type(a) \
+                or not isinstance(a, (dict, list)), (path, type(a), type(b))
+            if isinstance(a, dict):
+                assert set(a) == set(b), (path, set(a), set(b))
+                for k in a:
+                    assert_same(a[k], b[k], f"{path}/{k}")
+            elif isinstance(a, list):
+                assert len(a) == len(b), path
+                for i, (x, y) in enumerate(zip(a, b)):
+                    assert_same(x, y, f"{path}[{i}]")
+            else:
+                np.testing.assert_array_equal(np.asarray(b),
+                                              np.asarray(a), err_msg=path)
+
+        rng = np.random.default_rng(42)
+        for trial in range(200):
+            # top level must be dict-or-list of entries (npz needs >= 1 key)
+            tree = {"root": rand_tree(rng, 0)}
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w") as zf:
+                _write_npz(zf, "t.npz", tree)
+            with zipfile.ZipFile(io.BytesIO(buf.getvalue())) as zf:
+                back = _read_npz(zf, "t.npz")
+            assert_same(tree, back, f"trial{trial}")

@@ -47,11 +47,20 @@ def flash_default_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, n_k, kv_len, window):
+                *, scale, causal, block_q, block_k, n_k, kv_len, window,
+                n_band):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
+    if n_band is None:
+        ki, last = j, n_k - 1
+    else:
+        # banded scan: j indexes the k blocks this q block's window can
+        # touch; the index map fetched the SAME base+j block, and the
+        # band condition below masks any non-intersecting tile
+        ki = _band_base(qi, block_q, block_k, window, n_k, n_band) + j
+        last = n_band - 1
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, MASK_VALUE)
         l_ref[...] = jnp.zeros_like(l_ref)
@@ -90,7 +99,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     _when_block_in_band(causal, qi, ki, block_q, block_k, window, _compute)
 
-    @pl.when(ki == n_k - 1)
+    @pl.when(j == last)
     def _finalize():
         l = l_ref[...]                         # [block_q, LANES] replicated
         safe_l = jnp.where(l == 0.0, 1.0, l)   # fully-masked query rows
@@ -102,7 +111,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 def _when_block_in_band(causal, qi, ki, block_q, block_k, window, fn):
     """Run ``fn`` unless the whole tile is dead: above the causal
-    diagonal, or (sliding window) entirely below the band."""
+    diagonal or (sliding window) entirely below the band. The banded
+    grids' end-clamps only shift scans over tiles these conditions
+    mask, so no extra range check is needed."""
     cond = None
     if causal:
         cond = qi * block_q + block_q - 1 >= ki * block_k
@@ -115,6 +126,20 @@ def _when_block_in_band(causal, qi, ki, block_q, block_k, window, fn):
         @pl.when(cond)
         def _():
             fn()
+
+
+def _band_width(window, block_q, block_k, n_blocks):
+    """How many k blocks a q block's window can intersect (capped)."""
+    return min(n_blocks, -(-(window + block_q - 1) // block_k) + 1)
+
+
+def _band_base(qi, block_q, block_k, window, n_blocks, n_band):
+    """First k-block index of the ``n_band`` blocks scanned for q block
+    ``qi``: the window's first visible block, clamped so the scanned
+    range stays inside [0, n_blocks) (the clamp only shifts the range
+    over blocks the band condition masks anyway)."""
+    first = (qi * block_q - (window - 1)) // block_k
+    return jnp.clip(first, 0, n_blocks - n_band)
 
 
 def _round128(t: int) -> int:
@@ -152,11 +177,11 @@ def flash_attention_fwd(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Kernel launch. q: [b, tq, h, d]; k/v: [b, tkv, h, d].
     ``window`` (requires ``causal``) keeps k in (q-window, q] —
-    sliding-window local attention. Out-of-band tiles skip their MXU
-    math (``_when_block_in_band``) but the grid still visits and DMAs
-    every K/V block, so HBM traffic stays O(t²); an O(t·window) banded
-    grid (index_map as a function of qi and window) is the known
-    follow-up for long-t windowed configs.
+    sliding-window local attention on an O(t·window) BANDED grid: each
+    q block's scan visits only the k blocks its window can touch
+    (``_band_base``/``_band_width`` drive both the index maps and the
+    in-kernel block ids), so grid steps and K/V DMA scale with the
+    window, not t².
 
     Returns ``(out [b, tq, h, d], lse [b, h, tq])`` with no autodiff rule —
     use :func:`flash_attention` for training. ``causal`` assumes q and k
@@ -188,17 +213,29 @@ def flash_attention_fwd(
     tq_p, tkv_p = qf.shape[1], kf.shape[1]
     n_q, n_k = tq_p // block_q, tkv_p // block_k
 
+    # windowed: scan only the k blocks intersecting each q block's band
+    # (O(t*window) grid + DMA instead of O(t^2))
+    n_band = None if window is None else _band_width(window, block_q,
+                                                     block_k, n_k)
+    if n_band is None:
+        k_idx = lambda bh, qi, j: (bh, j, 0)
+        grid_k = n_k
+    else:
+        def k_idx(bh, qi, j):
+            return (bh, _band_base(qi, block_q, block_k, window,
+                                   n_k, n_band) + j, 0)
+        grid_k = n_band
     kernel = functools.partial(
         _fwd_kernel, scale=scale_val, causal=causal,
         block_q=block_q, block_k=block_k, n_k=n_k, kv_len=tkv,
-        window=window)
+        window=window, n_band=n_band)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, n_q, n_k),
+        grid=(b * h, n_q, grid_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), k_idx),
+            pl.BlockSpec((1, block_k, d), k_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -327,14 +364,34 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     return p, ds
 
 
+def _q_band_base(ki, block_q, block_k, n_blocks, n_band):
+    """First q-block index scanned for key block ``ki``: causality puts
+    the band's START at q == k (window-independent — only the WIDTH
+    depends on the window, via _q_band_width); clamped so the range
+    stays in [0, n_blocks)."""
+    first = (ki * block_k) // block_q
+    return jnp.clip(first, 0, n_blocks - n_band)
+
+
+def _q_band_width(window, block_q, block_k, n_blocks):
+    return min(n_blocks, -(-(block_k + window - 1) // block_q) + 1)
+
+
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                     block_q, block_k, n_q, q_len, kv_len, window):
-    """dk/dv for one key block, scanning query blocks."""
+                     block_q, block_k, n_q, q_len, kv_len, window,
+                     n_band):
+    """dk/dv for one key block, scanning query blocks (banded when
+    windowed: only q blocks with k in their window)."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)
+    if n_band is None:
+        qi, last = j, n_q - 1
+    else:
+        qi = _q_band_base(ki, block_q, block_k, n_q, n_band) + j
+        last = n_band - 1
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -355,7 +412,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     _when_block_in_band(causal, qi, ki, block_q, block_k, window,
                         _compute)
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(j == last)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -363,12 +420,18 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                   n_k, q_len, kv_len, window):
-    """dq for one query block, scanning key blocks (kv-major tiles)."""
+                   n_k, q_len, kv_len, window, n_band):
+    """dq for one query block, scanning key blocks (kv-major tiles;
+    banded to the window when set)."""
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
+    if n_band is None:
+        ki, last = j, n_k - 1
+    else:
+        ki = _band_base(qi, block_q, block_k, window, n_k, n_band) + j
+        last = n_band - 1
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
@@ -386,7 +449,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     _when_block_in_band(causal, qi, ki, block_q, block_k, window,
                         _compute)
 
-    @pl.when(ki == n_k - 1)
+    @pl.when(j == last)
     def _finalize():
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
@@ -453,10 +516,26 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
             pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, q_idx(i, j))),
         ]
 
+    # banded grids when windowed: dkdv scans only q blocks whose window
+    # reaches its k block; dq scans only k blocks in its q block's band
+    if window is None:
+        nb_q = nb_k = None
+        dkdv_q = lambda i, j: j
+        dq_k = lambda i, j: j
+        grid_dkdv, grid_dq = n_q, n_k
+    else:
+        nb_q = _q_band_width(window, block_q, block_k, n_q)
+        nb_k = _band_width(window, block_q, block_k, n_k)
+        dkdv_q = lambda i, j: _q_band_base(i, block_q, block_k,
+                                           n_q, nb_q) + j
+        dq_k = lambda i, j: _band_base(i, block_q, block_k, window,
+                                       n_k, nb_k) + j
+        grid_dkdv, grid_dq = nb_q, nb_k
+
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkdv_kernel, n_q=n_q, **common),
-        grid=(b * h, n_k, n_q),
-        in_specs=specs(q_idx=lambda i, j: j, k_idx=lambda i, j: i),
+        functools.partial(_bwd_dkdv_kernel, n_q=n_q, n_band=nb_q, **common),
+        grid=(b * h, n_k, grid_dkdv),
+        in_specs=specs(q_idx=dkdv_q, k_idx=lambda i, j: i),
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
@@ -475,9 +554,9 @@ def flash_backward_pallas(q, k, v, out, lse, do, *, causal: bool = False,
     )(qf, kf, vf, dof, lse_f, delta)
 
     (dq,) = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, n_k=n_k, **common),
-        grid=(b * h, n_q, n_k),
-        in_specs=specs(q_idx=lambda i, j: i, k_idx=lambda i, j: j),
+        functools.partial(_bwd_dq_kernel, n_k=n_k, n_band=nb_k, **common),
+        grid=(b * h, n_q, grid_dq),
+        in_specs=specs(q_idx=lambda i, j: i, k_idx=dq_k),
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         ],

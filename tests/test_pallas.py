@@ -331,3 +331,31 @@ class TestSlidingWindow:
         for fn in (flash_backward, flash_backward_pallas):
             with pytest.raises(ValueError, match="causal"):
                 fn(q, k, v, out, lse, q, causal=False, window=16)
+
+    def test_strongly_banded_long_sequence(self):
+        """t=512, window=64, block 64: the banded grid scans 3 of 8 key
+        blocks per query block; forward AND gradients must still match
+        the dense reference exactly."""
+        from deeplearning4j_tpu.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(1, 512, 2, 32, seed=24)
+        ref = dot_product_attention(q, k, v, causal=True, window=64)
+        out = flash_attention(q, k, v, causal=True, window=64,
+                              block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, k, v, causal=True, window=64) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, window=64, block_q=64,
+                block_k=64) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-4)

@@ -467,15 +467,46 @@ def bench_transformer(cpu_baseline=True):
     return result, vs_baseline
 
 
-def _await_backend(timeout_s: float = None):
-    """Initialize the accelerator backend with a hard timeout.
+def _probe_backend_subprocess(timeout_s: float):
+    """Probe backend liveness from a SHORT-LIVED CHILD process.
 
     The tunnel backend's device claim can block INDEFINITELY inside the
     PJRT C API when a previous client's grant is wedged (observed in
-    round 4: >3 h). A hung bench leaves the driver with no JSON at all;
-    this probe initializes jax in a daemon thread and, on timeout, emits
-    an honest error line and exits so the failure is recorded as data.
-    """
+    round 4: >3 h, and the in-process watchdog then eats its full budget
+    before reporting). A child that hangs in init can be killed safely
+    (a probe blocked in init holds no grant yet), so the wedge is
+    detected in ``timeout_s`` seconds without this process ever touching
+    the backend. Returns (ok, detail)."""
+    import subprocess
+    import sys
+
+    code = ("import jax; ds = jax.devices(); "
+            "print('PROBE_OK', len(ds), ds[0].platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, (f"backend init did not complete in {timeout_s:.0f}s "
+                       "(wedged device grant?)")
+    except OSError as e:
+        return False, f"probe spawn failed: {e}"
+    if proc.returncode != 0 or "PROBE_OK" not in proc.stdout:
+        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+        return False, f"probe rc={proc.returncode}: {tail}"
+    return True, proc.stdout.strip().splitlines()[-1]
+
+
+def _await_backend(timeout_s: float = None):
+    """Initialize the accelerator backend, wedge-proof and fail-fast.
+
+    Two layers: (1) a short-lived CHILD process probes the backend first,
+    so a wedged device grant is reported in seconds — the main process
+    never blocks on it; (2) only after the probe succeeds is jax
+    initialized in-process, still under a daemon-thread watchdog in case
+    the grant wedges between probe exit and our re-claim. Either failure
+    emits an honest error JSON line and exits so the driver records the
+    failure as data instead of a hang."""
     import os
     import threading
 
@@ -485,6 +516,23 @@ def _await_backend(timeout_s: float = None):
                 os.environ.get("BENCH_BACKEND_TIMEOUT_S", "300"))
         except ValueError:
             timeout_s = 300.0
+
+    # The probe gets its own SHORT cap: healthy tunnel init is ~20-40s,
+    # so 90s separates healthy from wedged without doubling the watchdog
+    # budget on the wedged-between-probe-and-reclaim path.
+    try:
+        probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
+                                       str(min(timeout_s, 90.0))))
+    except ValueError:
+        probe_s = min(timeout_s, 90.0)
+    ok, detail = _probe_backend_subprocess(probe_s)
+    if not ok:
+        _log(f"BACKEND UNAVAILABLE (child probe): {detail}")
+        print(_result_line({"error": f"backend unavailable: {detail}"},
+                           None, float("nan")), flush=True)
+        os._exit(0)
+    _log(f"child probe ok: {detail}")
+
     result = {}
     ready = threading.Event()
 
@@ -501,7 +549,7 @@ def _await_backend(timeout_s: float = None):
     if not ready.wait(timeout_s) or "error" in result:
         err = result.get(
             "error", f"backend init did not complete in {timeout_s:.0f}s "
-                     "(wedged device grant?)")
+                     "after a successful child probe (grant re-wedged?)")
         _log(f"BACKEND UNAVAILABLE: {err}")
         print(_result_line({"error": f"backend unavailable: {err}"},
                            None, float("nan")), flush=True)

@@ -224,25 +224,10 @@ def _convert_preprocessor(wrapped: dict) -> InputPreProcessor:
     raise ValueError(f"unknown reference preprocessor type {tag!r}")
 
 
-def from_reference_json(document: str) -> MultiLayerConfiguration:
-    """Load a reference-format ``MultiLayerConfiguration.toJson()`` document
-    (NeuralNetConfiguration.java:214-239 mapper conventions)."""
-    d = json.loads(document)
-    confs = d.get("confs")
-    if not confs:
-        raise ValueError("reference document has no 'confs' list")
-
-    layers = []
-    for conf in confs:
-        layer_doc = conf.get("layer")
-        if layer_doc is None:
-            raise ValueError("conf entry without a 'layer'")
-        layers.append(_convert_layer(layer_doc))
-
-    # network-wide hyperparameters come from the first conf (the reference
-    # clones one NeuralNetConfiguration per layer; trainer-level fields are
-    # replicated across them)
-    first = confs[0]
+def _convert_global_conf(first: dict, layers) -> GlobalConf:
+    """Network-wide hyperparameters from one reference
+    ``NeuralNetConfiguration`` document (the reference clones one per layer;
+    trainer-level fields are replicated across them)."""
     global_conf = GlobalConf(
         seed=int(first.get("seed", 12345)) & 0x7FFFFFFF,
         iterations=int(first.get("numIterations", 1)),
@@ -266,6 +251,29 @@ def from_reference_json(document: str) -> MultiLayerConfiguration:
         if layer.learning_rate is not None:
             global_conf.learning_rate = float(layer.learning_rate)
             break
+    return global_conf
+
+
+def from_reference_json(document: str) -> MultiLayerConfiguration:
+    """Load a reference-format ``MultiLayerConfiguration.toJson()`` document
+    (NeuralNetConfiguration.java:214-239 mapper conventions)."""
+    d = json.loads(document)
+    return _mln_from_reference_dict(d)
+
+
+def _mln_from_reference_dict(d: dict) -> MultiLayerConfiguration:
+    confs = d.get("confs")
+    if not confs:
+        raise ValueError("reference document has no 'confs' list")
+
+    layers = []
+    for conf in confs:
+        layer_doc = conf.get("layer")
+        if layer_doc is None:
+            raise ValueError("conf entry without a 'layer'")
+        layers.append(_convert_layer(layer_doc))
+
+    global_conf = _convert_global_conf(confs[0], layers)
 
     preprocessors = {
         int(i): _convert_preprocessor(p)
@@ -283,6 +291,156 @@ def from_reference_json(document: str) -> MultiLayerConfiguration:
         tbptt_fwd_length=int(d.get("tbpttFwdLength", 20)),
         tbptt_back_length=int(d.get("tbpttBackLength", 20)),
     )
+
+
+def graph_from_reference_json(document: str):
+    """Load a reference-format ``ComputationGraphConfiguration.toJson()``
+    document (ComputationGraphConfiguration.java:113,129 mapper
+    conventions) into the native
+    :class:`~deeplearning4j_tpu.nn.conf.graph.ComputationGraphConfiguration`.
+
+    Reference shape (Jackson field names from
+    ComputationGraphConfiguration.java:59-81, vertex WRAPPER_OBJECT tags
+    from nn/conf/graph/GraphVertex.java:37-44)::
+
+        {
+          "vertices": {
+            "dense1": {"LayerVertex": {
+                "layerConf": {"layer": {"dense": {...}}, "seed": 123, ...},
+                "preProcessor": {"cnnToFeedForward": {...}}}},
+            "merge": {"MergeVertex": {}},
+            "ew": {"ElementWiseVertex": {"op": "Add"}},
+            "sub": {"SubsetVertex": {"from": 0, "to": 9}},
+            "last": {"LastTimeStepVertex": {"maskArrayInputName": "in"}},
+            "dup": {"DuplicateToTimeSeriesVertex": {"inputName": "in"}},
+            "pre": {"PreprocessorVertex": {"preProcessor": {...}}}
+          },
+          "vertexInputs": {"dense1": ["in"], ...},
+          "networkInputs": ["in"], "networkOutputs": ["out"],
+          "pretrain": true, "backprop": false,
+          "backpropType": "Standard",
+          "tbpttFwdLength": 20, "tbpttBackLength": 20,
+          "defaultConfiguration": {...}
+        }
+    """
+    d = json.loads(document)
+    return _graph_from_reference_dict(d)
+
+
+def _graph_from_reference_dict(d: dict):
+    from deeplearning4j_tpu.nn.conf import graph as G
+
+    vertices_doc = d.get("vertices")
+    if not vertices_doc:
+        raise ValueError("reference graph document has no 'vertices' map")
+    inputs = list(d.get("networkInputs") or [])
+    outputs = list(d.get("networkOutputs") or [])
+    if not inputs or not outputs:
+        raise ValueError(
+            "reference graph document needs networkInputs and networkOutputs")
+
+    layers: Dict[str, Any] = {}
+    vertices: Dict[str, Any] = {}
+    preprocessors: Dict[str, Any] = {}
+    layer_conf_docs = []
+    for name, wrapped in vertices_doc.items():
+        if len(wrapped) != 1:
+            raise ValueError(
+                f"vertex {name!r}: expected one Jackson wrapper-object key, "
+                f"got {list(wrapped)}")
+        (tag, fields), = wrapped.items()
+        fields = fields or {}
+        if tag == "LayerVertex":
+            layer_conf = fields.get("layerConf") or {}
+            layer_doc = layer_conf.get("layer")
+            if layer_doc is None:
+                raise ValueError(f"LayerVertex {name!r} without a layer")
+            layer = _convert_layer(layer_doc)
+            layer.name = name
+            layers[name] = layer
+            layer_conf_docs.append(layer_conf)
+            pre = fields.get("preProcessor")
+            if pre:
+                preprocessors[name] = _convert_preprocessor(pre)
+        elif tag == "MergeVertex":
+            vertices[name] = G.MergeVertex()
+        elif tag == "ElementWiseVertex":
+            vertices[name] = G.ElementWiseVertex(op=fields.get("op", "Add"))
+        elif tag == "SubsetVertex":
+            vertices[name] = G.SubsetVertex(
+                from_index=int(fields.get("from", 0)),
+                to_index=int(fields.get("to", 0)))
+        elif tag == "LastTimeStepVertex":
+            vertices[name] = G.LastTimeStepVertex(
+                mask_input=fields.get("maskArrayInputName"))
+        elif tag == "DuplicateToTimeSeriesVertex":
+            vertices[name] = G.DuplicateToTimeSeriesVertex(
+                input_name=fields.get("inputName"))
+        elif tag == "PreprocessorVertex":
+            pre = fields.get("preProcessor")
+            vertices[name] = G.PreprocessorVertex(
+                preprocessor=_convert_preprocessor(pre).to_dict()
+                if pre else None)
+        else:
+            raise ValueError(
+                f"unknown reference graph vertex type {tag!r} "
+                "(known: LayerVertex, MergeVertex, ElementWiseVertex, "
+                "SubsetVertex, LastTimeStepVertex, "
+                "DuplicateToTimeSeriesVertex, PreprocessorVertex)")
+
+    vertex_inputs = {n: list(v)
+                     for n, v in (d.get("vertexInputs") or {}).items()}
+
+    # global hyperparameters: defaultConfiguration if present, else the
+    # first LayerVertex's cloned conf (both are full reference
+    # NeuralNetConfiguration documents)
+    source = d.get("defaultConfiguration") or (
+        layer_conf_docs[0] if layer_conf_docs else {})
+    global_conf = _convert_global_conf(source, list(layers.values()))
+
+    return G.ComputationGraphConfiguration(
+        global_conf=global_conf,
+        inputs=inputs,
+        outputs=outputs,
+        layers=layers,
+        vertices=vertices,
+        vertex_inputs=vertex_inputs,
+        preprocessors=preprocessors,
+        backprop=bool(d.get("backprop", True)),
+        pretrain=bool(d.get("pretrain", False)),
+        backprop_type=_safe_enum(BackpropType, d.get("backpropType"),
+                                 BackpropType.STANDARD),
+        tbptt_fwd_length=int(d.get("tbpttFwdLength", 20)),
+        tbptt_back_length=int(d.get("tbpttBackLength", 20)),
+    )
+
+
+def from_reference_yaml(document: str) -> MultiLayerConfiguration:
+    """Load a reference-format ``MultiLayerConfiguration.toYaml()`` document.
+
+    The reference emits via Jackson's SnakeYAML mapper
+    (NeuralNetConfiguration.java:214-239 toYaml/fromYaml,
+    MultiLayerConfiguration.java fromYaml) — block mappings/sequences with
+    double-quoted strings and an optional ``---`` document marker; the field
+    and wrapper-tag vocabulary is identical to the JSON form, so the parsed
+    tree routes through the same translation."""
+    from deeplearning4j_tpu.utils.yamlio import load
+
+    d = load(document)
+    if not isinstance(d, dict):
+        raise ValueError("reference YAML document is not a mapping")
+    return _mln_from_reference_dict(d)
+
+
+def graph_from_reference_yaml(document: str):
+    """Load a reference-format ``ComputationGraphConfiguration.toYaml()``
+    document (ComputationGraphConfiguration.java:86-96)."""
+    from deeplearning4j_tpu.utils.yamlio import load
+
+    d = load(document)
+    if not isinstance(d, dict):
+        raise ValueError("reference YAML document is not a mapping")
+    return _graph_from_reference_dict(d)
 
 
 def _safe_enum(enum_cls, value, default):

@@ -253,6 +253,22 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    @staticmethod
+    def from_reference_json(s: str) -> "ComputationGraphConfiguration":
+        """Load a reference-format ``ComputationGraphConfiguration.toJson()``
+        document (ComputationGraphConfiguration.java:113,129)."""
+        from deeplearning4j_tpu.nn.conf.compat import graph_from_reference_json
+
+        return graph_from_reference_json(s)
+
+    @staticmethod
+    def from_reference_yaml(s: str) -> "ComputationGraphConfiguration":
+        """Load a reference-format ``toYaml()`` document
+        (ComputationGraphConfiguration.java:86-96, SnakeYAML mapper)."""
+        from deeplearning4j_tpu.nn.conf.compat import graph_from_reference_yaml
+
+        return graph_from_reference_yaml(s)
+
     def to_yaml(self) -> str:
         """Block-style YAML (ComputationGraphConfiguration toYaml parity)."""
         from deeplearning4j_tpu.utils.yamlio import dump

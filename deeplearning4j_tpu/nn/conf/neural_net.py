@@ -182,6 +182,15 @@ class MultiLayerConfiguration:
         return from_reference_json(s)
 
     @staticmethod
+    def from_reference_yaml(s: str) -> "MultiLayerConfiguration":
+        """Load a document produced by the REFERENCE's SnakeYAML
+        ``MultiLayerConfiguration.toYaml()``
+        (NeuralNetConfiguration.java:214-239)."""
+        from deeplearning4j_tpu.nn.conf.compat import from_reference_yaml
+
+        return from_reference_yaml(s)
+
+    @staticmethod
     def from_yaml(s: str) -> "MultiLayerConfiguration":
         """Parse to_yaml output (also accepts plain JSON, which is valid
         YAML and was this method's historical input format)."""

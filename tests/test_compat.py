@@ -317,6 +317,285 @@ class TestYamlEdgeCases:
         assert yamlio.load(yamlio.dump(doc)) == doc
 
 
+REFERENCE_GRAPH_JSON = json.dumps({
+    # Hand-built to ComputationGraphConfiguration.toJson() conventions:
+    # Jackson field names (ComputationGraphConfiguration.java:59-81) and
+    # GraphVertex WRAPPER_OBJECT tags (nn/conf/graph/GraphVertex.java:37-44).
+    "vertices": {
+        "d1": {"LayerVertex": {"layerConf": {
+            "layer": {"dense": {"nIn": 8, "nOut": 6,
+                                "activationFunction": "relu",
+                                "weightInit": "XAVIER", "updater": "ADAM",
+                                "learningRate": 0.05}},
+            "seed": 11, "numIterations": 1}}},
+        "d2": {"LayerVertex": {"layerConf": {
+            "layer": {"dense": {"nIn": 8, "nOut": 6,
+                                "activationFunction": "relu",
+                                "updater": "ADAM", "learningRate": 0.05}},
+            "seed": 11, "numIterations": 1}}},
+        "ew": {"ElementWiseVertex": {"op": "Add"}},
+        "lstm": {"LayerVertex": {"layerConf": {
+            "layer": {"gravesLSTM": {"nIn": 4, "nOut": 6,
+                                     "activationFunction": "tanh",
+                                     "updater": "ADAM",
+                                     "learningRate": 0.05}},
+            "seed": 11, "numIterations": 1}}},
+        "last": {"LastTimeStepVertex": {"maskArrayInputName": "seq"}},
+        "dup": {"DuplicateToTimeSeriesVertex": {"inputName": "seq"}},
+        "rnnout": {"LayerVertex": {"layerConf": {
+            "layer": {"rnnoutput": {"nIn": 6, "nOut": 2,
+                                    "activationFunction": "softmax",
+                                    "lossFunction": "MCXENT",
+                                    "updater": "ADAM",
+                                    "learningRate": 0.05}},
+            "seed": 11, "numIterations": 1}}},
+        "merge": {"MergeVertex": {}},
+        "sub": {"SubsetVertex": {"from": 0, "to": 9}},
+        "out": {"LayerVertex": {"layerConf": {
+            "layer": {"output": {"nIn": 10, "nOut": 3,
+                                 "activationFunction": "softmax",
+                                 "lossFunction": "MCXENT",
+                                 "updater": "ADAM", "learningRate": 0.05}},
+            "seed": 11, "numIterations": 1}}},
+    },
+    "vertexInputs": {
+        "d1": ["in"], "d2": ["in"], "ew": ["d1", "d2"],
+        "lstm": ["seq"], "last": ["lstm"],
+        "dup": ["ew"], "rnnout": ["dup"],
+        "merge": ["ew", "last"], "sub": ["merge"], "out": ["sub"],
+    },
+    "networkInputs": ["in", "seq"],
+    "networkOutputs": ["out", "rnnout"],
+    "pretrain": False, "backprop": True,
+    "backpropType": "Standard",
+    "tbpttFwdLength": 20, "tbpttBackLength": 20,
+})
+
+
+class TestReferenceGraphJsonLoader:
+    """Reference ComputationGraphConfiguration.toJson() compat
+    (ComputationGraphConfiguration.java:113,129; GraphVertex.java:37-44)."""
+
+    def _load(self):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+
+        return ComputationGraphConfiguration.from_reference_json(
+            REFERENCE_GRAPH_JSON)
+
+    def test_structure_translation(self):
+        from deeplearning4j_tpu.nn.conf import graph as G
+
+        conf = self._load()
+        assert conf.inputs == ["in", "seq"]
+        assert conf.outputs == ["out", "rnnout"]
+        assert set(conf.layers) == {"d1", "d2", "lstm", "rnnout", "out"}
+        assert isinstance(conf.vertices["merge"], G.MergeVertex)
+        ew = conf.vertices["ew"]
+        assert isinstance(ew, G.ElementWiseVertex) and ew.op == "Add"
+        sub = conf.vertices["sub"]
+        assert (sub.from_index, sub.to_index) == (0, 9)
+        last = conf.vertices["last"]
+        assert isinstance(last, G.LastTimeStepVertex)
+        assert last.mask_input == "seq"
+        dup = conf.vertices["dup"]
+        assert isinstance(dup, G.DuplicateToTimeSeriesVertex)
+        assert dup.input_name == "seq"
+        assert conf.vertex_inputs["merge"] == ["ew", "last"]
+        assert conf.global_conf.seed == 11
+        assert conf.global_conf.learning_rate == pytest.approx(0.05)
+        # round-trips through our native serde unchanged
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+        assert ComputationGraphConfiguration.from_json(conf.to_json()) == conf
+
+    def test_loaded_graph_trains_and_outputs(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = self._load()
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((4, 8), np.float32)
+        seq = rng.random((4, 5, 4), np.float32)
+        y0 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        y1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 5))]
+        net.fit([x, seq], [y0, y1])
+        s0 = net.score_value
+        for _ in range(5):
+            net.fit([x, seq], [y0, y1])
+        assert np.isfinite(net.score_value) and net.score_value < s0
+        outs = net.output(x, seq)
+        assert outs[0].shape == (4, 3)
+        assert outs[1].shape == (4, 5, 2)
+
+    def test_layer_vertex_preprocessor(self):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+
+        doc = json.dumps({
+            "vertices": {
+                "d": {"LayerVertex": {
+                    "layerConf": {"layer": {"dense": {
+                        "nIn": 192, "nOut": 10,
+                        "activationFunction": "relu"}}, "seed": 1},
+                    "preProcessor": {"cnnToFeedForward": {
+                        "inputHeight": 4, "inputWidth": 4,
+                        "numChannels": 12}}}},
+                "out": {"LayerVertex": {"layerConf": {
+                    "layer": {"output": {"nIn": 10, "nOut": 2,
+                                         "lossFunction": "MCXENT"}},
+                    "seed": 1}}},
+            },
+            "vertexInputs": {"d": ["in"], "out": ["d"]},
+            "networkInputs": ["in"],
+            "networkOutputs": ["out"],
+        })
+        conf = ComputationGraphConfiguration.from_reference_json(doc)
+        pre = conf.preprocessors["d"]
+        assert isinstance(pre, CnnToFeedForwardPreProcessor)
+        assert (pre.height, pre.width, pre.channels) == (4, 4, 12)
+
+    def test_rejects_unknown_vertex_and_empty(self):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+
+        with pytest.raises(ValueError, match="no 'vertices'"):
+            ComputationGraphConfiguration.from_reference_json("{}")
+        with pytest.raises(ValueError, match="unknown reference graph"):
+            ComputationGraphConfiguration.from_reference_json(json.dumps({
+                "vertices": {"x": {"FrobnicateVertex": {}}},
+                "vertexInputs": {"x": ["in"]},
+                "networkInputs": ["in"], "networkOutputs": ["x"],
+            }))
+
+
+class TestReferenceYamlLoader:
+    """Reference toYaml() compat for both conf classes
+    (NeuralNetConfiguration.java:214-239,
+    ComputationGraphConfiguration.java:86-96). Documents are hand-built to
+    Jackson/SnakeYAML block conventions: '---' marker, double-quoted
+    strings, camelCase fields, wrapper-object tags as nested mappings."""
+
+    MLN_YAML = '\n'.join([
+        '---',
+        'backprop: true',
+        'pretrain: false',
+        'backpropType: "TruncatedBPTT"',
+        'tbpttFwdLength: 8',
+        'tbpttBackLength: 8',
+        'confs:',
+        '- layer:',
+        '    gravesLSTM:',
+        '      nIn: 10',
+        '      nOut: 16',
+        '      activationFunction: "tanh"',
+        '      updater: "ADAM"',
+        '      learningRate: 0.02',
+        '  seed: 7',
+        '  numIterations: 1',
+        '  optimizationAlgo: "STOCHASTIC_GRADIENT_DESCENT"',
+        '- layer:',
+        '    rnnoutput:',
+        '      nIn: 16',
+        '      nOut: 10',
+        '      activationFunction: "softmax"',
+        '      lossFunction: "MCXENT"',
+        '      updater: "ADAM"',
+        '      learningRate: 0.02',
+        '  seed: 7',
+        '  numIterations: 1',
+    ]) + '\n'
+
+    def test_mln_reference_yaml(self):
+        conf = MultiLayerConfiguration.from_reference_yaml(self.MLN_YAML)
+        assert conf.backprop_type == BackpropType.TRUNCATED_BPTT
+        assert conf.tbptt_fwd_length == 8
+        kinds = [type(l).__name__ for l in conf.layers]
+        assert kinds == ["GravesLSTM", "RnnOutputLayer"]
+        assert conf.layers[0].n_out == 16
+        assert conf.global_conf.seed == 7
+        # equivalent JSON document loads to an equal configuration
+        as_json = json.dumps({
+            "backprop": True, "pretrain": False,
+            "backpropType": "TruncatedBPTT",
+            "tbpttFwdLength": 8, "tbpttBackLength": 8,
+            "confs": [
+                {"layer": {"gravesLSTM": {
+                    "nIn": 10, "nOut": 16, "activationFunction": "tanh",
+                    "updater": "ADAM", "learningRate": 0.02}},
+                 "seed": 7, "numIterations": 1,
+                 "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT"},
+                {"layer": {"rnnoutput": {
+                    "nIn": 16, "nOut": 10, "activationFunction": "softmax",
+                    "lossFunction": "MCXENT", "updater": "ADAM",
+                    "learningRate": 0.02}},
+                 "seed": 7, "numIterations": 1},
+            ],
+        })
+        assert conf == MultiLayerConfiguration.from_reference_json(as_json)
+
+    GRAPH_YAML = '\n'.join([
+        '---',
+        'vertices:',
+        '  d1:',
+        '    LayerVertex:',
+        '      layerConf:',
+        '        layer:',
+        '          dense:',
+        '            nIn: 4',
+        '            nOut: 3',
+        '            activationFunction: "relu"',
+        '            learningRate: 0.05',
+        '        seed: 5',
+        '  sub:',
+        '    SubsetVertex:',
+        '      from: 0',
+        '      to: 1',
+        '  out:',
+        '    LayerVertex:',
+        '      layerConf:',
+        '        layer:',
+        '          output:',
+        '            nIn: 2',
+        '            nOut: 2',
+        '            lossFunction: "MCXENT"',
+        '            learningRate: 0.05',
+        '        seed: 5',
+        'vertexInputs:',
+        '  d1:',
+        '  - "in"',
+        '  sub:',
+        '  - "d1"',
+        '  out:',
+        '  - "sub"',
+        'networkInputs:',
+        '- "in"',
+        'networkOutputs:',
+        '- "out"',
+        'backprop: true',
+        'pretrain: false',
+    ]) + '\n'
+
+    def test_graph_reference_yaml(self):
+        from deeplearning4j_tpu.nn.conf import graph as G
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = ComputationGraphConfiguration.from_reference_yaml(
+            self.GRAPH_YAML)
+        assert conf.inputs == ["in"] and conf.outputs == ["out"]
+        sub = conf.vertices["sub"]
+        assert isinstance(sub, G.SubsetVertex)
+        assert (sub.from_index, sub.to_index) == (0, 1)
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.random((6, 4), np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+        net.fit([x], [y])
+        assert np.isfinite(net.score_value)
+
+
 class TestReferenceJsonFullLayerMatrix:
     """Every Jackson wrapper tag in Layer.java:44-59 translates."""
 

@@ -16,6 +16,7 @@ via the dtype policy.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -37,6 +38,8 @@ from deeplearning4j_tpu.parallel.mesh import (
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
 from deeplearning4j_tpu.pallas.flash_attention import (
     flash_attention, flash_default_interpret)
+
+logger = logging.getLogger(__name__)
 
 
 def _rope(x, positions, base: float = 10000.0):
@@ -78,7 +81,8 @@ class TransformerLM:
                  dtype_policy: str = "float32", attn_impl: str = "auto",
                  remat: bool = False, pos_encoding: str = "learned",
                  num_kv_heads: Optional[int] = None,
-                 attn_window: Optional[int] = None):
+                 attn_window: Optional[int] = None,
+                 sp_impl: str = "ring"):
         assert d_model % num_heads == 0
         # "auto": Pallas flash kernel when a TPU backend is attached and
         # head_dim maps onto lane tiles; "xla" / "flash" force a path
@@ -104,10 +108,20 @@ class TransformerLM:
                 f"num_heads={num_heads}")
         # sliding-window local attention: each query sees only the last
         # attn_window keys (None = full causal attention); composes with
-        # the XLA, grouped, and flash paths (NOT ring)
+        # the XLA, grouped, flash, ring, and ulysses paths
         if attn_window is not None and attn_window < 1:
             raise ValueError(f"attn_window={attn_window} must be >= 1")
         self.attn_window = attn_window
+        # sequence-parallel strategy when training with
+        # sequence_parallel=True: "ring" (K/V rotate around the sequence
+        # axis via ppermute — best at huge T) or "ulysses" (two
+        # all-to-alls reshard sequence<->heads — best when heads >= ring
+        # size and ICI all-to-all bandwidth is plentiful). Switchable per
+        # model; parallel/ulysses.py documents the trade.
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl={sp_impl!r} must be 'ring' or "
+                             "'ulysses'")
+        self.sp_impl = sp_impl
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) instead of keeping them live across the whole
         # step — trades ~1/3 more FLOPs for O(sqrt) activation memory, the
@@ -210,12 +224,18 @@ class TransformerLM:
         if attention is not None:
             o = attention(q, k, v)
         elif sequence_parallel and mesh is not None:
-            if self.attn_window is not None:
-                raise NotImplementedError(
-                    "attn_window is not supported with sequence-parallel "
-                    "ring attention")
-            o = ring_attention(q, self._repeat_kv(k), self._repeat_kv(v),
-                               mesh, causal=True, impl=self._attn_impl(t))
+            if self.sp_impl == "ulysses":
+                from deeplearning4j_tpu.parallel.ulysses import (
+                    ulysses_attention)
+
+                o = ulysses_attention(
+                    q, self._repeat_kv(k), self._repeat_kv(v), mesh,
+                    causal=True, window=self.attn_window)
+            else:
+                o = ring_attention(q, self._repeat_kv(k),
+                                   self._repeat_kv(v), mesh, causal=True,
+                                   impl=self._attn_impl(t),
+                                   window=self.attn_window)
         elif self._attn_impl(t) == "flash":
             o = flash_attention(q, self._repeat_kv(k), self._repeat_kv(v),
                                 causal=True, window=self.attn_window)
@@ -587,16 +607,29 @@ class TransformerLM:
 
         return jax.jit(gen)
 
+    # a serving loop with varying prompt lengths compiles one program per
+    # (shape, sampling) signature; bound the cache so it cannot grow
+    # without limit (LRU — jax's own executable cache keeps recently
+    # evicted programs warm if the signature comes right back)
+    GEN_CACHE_MAX = 16
+
     def _cached_decoder(self, sig, factory):
-        """Lazy per-signature compile cache shared by the decode APIs."""
+        """Lazy per-signature compile cache shared by the decode APIs
+        (LRU-bounded at ``GEN_CACHE_MAX`` signatures)."""
+        from collections import OrderedDict
+
         if self.params is None:
             self.init()
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
-            cache = self._gen_cache = {}
+            cache = self._gen_cache = OrderedDict()
         fn = cache.get(sig)
         if fn is None:
             fn = cache[sig] = factory()
+            while len(cache) > self.GEN_CACHE_MAX:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(sig)
         return fn
 
     def generate_beam(self, prompt, max_new_tokens: int, beam_size: int = 4):
@@ -627,15 +660,31 @@ class TransformerLM:
     # tensor-parallel sharding specs (Megatron split)
     # ------------------------------------------------------------------
     def param_specs(self, *, shard_data_embed: bool = False,
-                    model_axis_size: Optional[int] = None) -> Dict[str, Any]:
+                    model_axis_size: Optional[int] = None,
+                    mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+        if mesh is not None and model_axis_size is None:
+            model_axis_size = dict(mesh.shape).get(MODEL_AXIS, 1)
         col = P(None, MODEL_AXIS)
         row = P(MODEL_AXIS, None)
         # the Megatron split shards whole heads per device; with GQA the
         # kv heads must tile the model axis or shards cut inside a head
-        # and K/V regather defeats the split — replicate wk/wv then
-        # (pass model_axis_size, as shard_params does, to enable this)
+        # and K/V regather defeats the split — replicate wk/wv then.
+        # Whether that applies depends on the axis size, so GQA/MQA specs
+        # REQUIRE it (pass model_axis_size or mesh; shard_params does) —
+        # a silent column default could emit an in-head-splitting sharding.
         kv_col = col
+        if self.num_kv_heads != self.num_heads and model_axis_size is None:
+            raise ValueError(
+                "param_specs with GQA/MQA needs model_axis_size= (or "
+                f"mesh=): whether the {self.num_kv_heads} kv heads can be "
+                "column-sharded depends on the model-axis size")
         if model_axis_size and self.num_kv_heads % model_axis_size:
+            logger.warning(
+                "GQA TP fallback: num_kv_heads=%d does not tile the "
+                "model axis (size %d) — wk/wv stay REPLICATED (no TP "
+                "memory/compute savings on the K/V projections; with "
+                "MQA that is all of them)",
+                self.num_kv_heads, model_axis_size)
             kv_col = P()
         blocks = []
         for _ in range(self.num_layers):

@@ -24,6 +24,22 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def causal_band_mask(tq: int, tkv: int, *, window: Optional[int] = None,
+                     q_offset=0, k_offset=0) -> jnp.ndarray:
+    """[tq, tkv] bool keep-mask for causal attention, optionally banded to
+    the sliding window ``k in (q - window, q]``. The ONE definition of the
+    band convention — dot_product/grouped attention, ring `_block_attn`,
+    and ulysses `_local_attention` all build their masks here, so the
+    three paths cannot drift. Offsets are the absolute positions of
+    q[0]/k[0] (may be traced) for blockwise callers."""
+    qi = q_offset + jnp.arange(tq)[:, None]
+    ki = k_offset + jnp.arange(tkv)[None, :]
+    keep = qi >= ki
+    if window is not None:
+        keep &= qi - ki < window
+    return keep
+
+
 def dot_product_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -52,14 +68,9 @@ def dot_product_attention(
     if bias is not None:
         logits = logits + bias
     if causal:
-        tq, tkv = q.shape[1], k.shape[1]
-        # allow tq != tkv (e.g. blockwise): positions are absolute offsets
-        qi = jnp.arange(tq)[:, None]
-        ki = jnp.arange(tkv)[None, :]
-        keep = qi >= ki
-        if window is not None:
-            keep &= qi - ki < window
-        logits = jnp.where(keep, logits, NEG_INF)
+        logits = jnp.where(causal_band_mask(q.shape[1], k.shape[1],
+                                            window=window),
+                           logits, NEG_INF)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :].astype(bool), logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
@@ -100,13 +111,8 @@ def grouped_query_attention(
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
-        tkv = k.shape[1]
-        qi = jnp.arange(tq)[:, None]
-        ki = jnp.arange(tkv)[None, :]
-        keep = qi >= ki
-        if window is not None:
-            keep &= qi - ki < window
-        logits = jnp.where(keep, logits, NEG_INF)
+        logits = jnp.where(causal_band_mask(tq, k.shape[1], window=window),
+                           logits, NEG_INF)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, None, :].astype(bool),
                            logits, NEG_INF)

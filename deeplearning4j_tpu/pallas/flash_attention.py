@@ -265,7 +265,8 @@ def flash_attention_fwd(
 
 def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
                    scale: Optional[float] = None, block_k: int = 1024,
-                   q_offset=0, k_offset=0, window: Optional[int] = None):
+                   q_offset=0, k_offset=0, window: Optional[int] = None,
+                   precise: bool = False):
     """Chunked flash backward (XLA scan). The production paths use the
     Pallas kernels (:func:`flash_backward_pallas`, used by both the
     custom_vjp and the ring backward); this scan version remains as the
@@ -277,6 +278,11 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
 
     q/out/do: [b, tq, h, d]; k/v: [b, tkv, h, d]; lse: [b, h, tq].
     Returns (dq, dk, dv) in the input layouts (float32).
+
+    ``precise=True`` runs every matmul with f32 OPERANDS. Parity tests
+    use it so the oracle is genuinely higher-precision than the bf16
+    kernels — with both sides casting operands to the input dtype, a
+    shared reduced-precision bug class would cancel out and hide.
     """
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal=True and window >= 1")
@@ -287,9 +293,14 @@ def flash_backward(q, k, v, out, lse, do, *, causal: bool = False,
     # matmul operands stay in the INPUT dtype (bf16 under the mixed
     # policy) with f32 accumulation via preferred_element_type — casting
     # them to f32 would run every backward einsum at the f32 MXU rate.
-    # Softmax math (p, ds, delta) stays f32.
+    # Softmax math (p, ds, delta) stays f32. (precise=True overrides for
+    # the oracle use-case above.)
+    op_dtype = jnp.float32 if precise else q.dtype
     mm = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
-    dof = do.astype(q.dtype)
+    q = q.astype(op_dtype)
+    k = k.astype(op_dtype)
+    v = v.astype(op_dtype)
+    dof = do.astype(op_dtype)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)                                 # [b, tq, h]
     delta = delta.transpose(0, 2, 1)                         # [b, h, tq]

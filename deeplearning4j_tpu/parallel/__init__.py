@@ -18,7 +18,12 @@ Modes:
 - ``TensorParallel`` sharding rules (tensor_parallel.py) — param/activation
   PartitionSpecs over a ``model`` axis.
 - ``ring_attention`` (ring_attention.py) — context parallelism over a
-  ``sequence`` axis via shard_map + ppermute.
+  ``sequence`` axis via shard_map + ppermute; composes with sliding-window
+  banding (only in-band ring hops run).
+- ``ulysses_attention`` (ulysses.py) — the all-to-all flavor of sequence
+  parallelism: reshard sequence↔heads, attend locally over the full
+  sequence, reshard back. ``TransformerLM(sp_impl="ulysses")`` switches a
+  model onto it.
 - ``spmd_pipeline`` (pipeline_parallel.py) — GPipe microbatch pipelining over
   a ``pipe`` axis via shard_map + ppermute.
 - ``moe_ffn`` (expert_parallel.py) — GShard-style mixture-of-experts with
@@ -46,6 +51,14 @@ from deeplearning4j_tpu.parallel.expert_parallel import (  # noqa: F401
     init_moe_params,
     moe_ffn,
     shard_moe_params,
+)
+from deeplearning4j_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention_sharded,
+)
+from deeplearning4j_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_self_attention_sharded,
 )
 from deeplearning4j_tpu.parallel.fsdp import (  # noqa: F401
     FSDP,

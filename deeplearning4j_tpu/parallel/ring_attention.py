@@ -35,20 +35,22 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from deeplearning4j_tpu.ops.attention import NEG_INF
+from deeplearning4j_tpu.ops.attention import NEG_INF, causal_band_mask
 from deeplearning4j_tpu.parallel.mesh import SEQUENCE_AXIS
 
 
-def _block_attn(q, k, v, q_offset, k_offset, *, causal, scale):
+def _block_attn(q, k, v, q_offset, k_offset, *, causal, scale,
+                window=None):
     """Blockwise attention logits for absolute positions; returns
-    (scores·v contribution, running-max, normalizer pieces)."""
+    (scores·v contribution, running-max, normalizer pieces). ``window``
+    (requires causal) keeps k in ``(q - window, q]`` — same sliding-window
+    convention as ``ops.attention``."""
     # q: [b, tq, h, d]; k/v: [b, tk, h, d]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
-        tq, tk = q.shape[1], k.shape[1]
-        qi = q_offset + jnp.arange(tq)[:, None]
-        ki = k_offset + jnp.arange(tk)[None, :]
-        logits = jnp.where(qi >= ki, logits, NEG_INF)
+        keep = causal_band_mask(q.shape[1], k.shape[1], window=window,
+                                q_offset=q_offset, k_offset=k_offset)
+        logits = jnp.where(keep, logits, NEG_INF)
     m = jnp.max(logits, axis=-1)  # [b, h, tq]
     p = jnp.exp(logits - m[..., None])
     l = jnp.sum(p, axis=-1)  # [b, h, tq]
@@ -223,6 +225,7 @@ def ring_attention(
     axis_name: str = SEQUENCE_AXIS,
     impl: str = "xla",
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring attention over ``axis_name``. q/k/v: [b, t, h, d] GLOBAL arrays
     (sharded or shardable on the time axis); returns [b, t, h, d] sharded the
@@ -230,9 +233,22 @@ def ring_attention(
 
     ``impl="flash"`` runs each block through the Pallas flash kernel with a
     ring-level custom VJP; ``"xla"`` (default) uses fused jnp blockwise math.
+
+    ``window`` (requires ``causal=True``) composes sliding-window attention
+    with the ring: each q block's band ``(q - window, q]`` intersects at most
+    ``ceil((window-1)/t_local) + 1`` owner blocks, so the ring runs only that
+    many hops — rotating AGAINST the causal direction so the needed
+    previous-neighbor blocks arrive first and the loop stops as soon as the
+    band is covered (a windowed ring is strictly cheaper than a full ring).
+    The flash impl falls back to the blockwise-XLA body when a window is set
+    (the Pallas kernel's banded grid assumes q/k aligned at offset 0, which
+    ring hops violate); the fallback trains identically, just without the
+    Pallas per-block kernels.
     """
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown ring attention impl {impl!r}")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     d = q.shape[-1]
     scale_val = scale if scale is not None else float(1.0 / (d ** 0.5))
     if axis_name not in mesh.shape:
@@ -243,12 +259,18 @@ def ring_attention(
                 flash_attention)
 
             return flash_attention(q, k, v, causal=causal, scale=scale_val,
-                                   interpret=interpret)
-        pv, m, l = _block_attn(q, k, v, 0, 0, causal=causal, scale=scale_val)
+                                   window=window, interpret=interpret)
+        pv, m, l = _block_attn(q, k, v, 0, 0, causal=causal, scale=scale_val,
+                               window=window)
         denom = jnp.maximum(jnp.swapaxes(l, 1, 2)[..., None], 1e-30)
         return (pv.astype(jnp.float32) / denom).astype(q.dtype)
     n_ring = mesh.shape[axis_name]
     t_local = q.shape[1] // n_ring
+
+    if window is not None:
+        return _windowed_ring(q, k, v, mesh, axis_name=axis_name,
+                              scale=scale_val, window=window,
+                              n_ring=n_ring, t_local=t_local)
 
     if impl == "flash":
         cfg = _RingFlashConfig(causal, scale_val, n_ring, axis_name,
@@ -304,6 +326,66 @@ def ring_attention(
         check_vma=False,
     )
     return sharded(q, k, v)
+
+
+def _windowed_ring(q, k, v, mesh, *, axis_name, scale, window, n_ring,
+                   t_local):
+    """Causal sliding-window ring: only the ``n_steps`` hops whose k blocks
+    can intersect any band run at all. The ring rotates so device i holds
+    the block of owner ``(i - s) mod n`` at step s (previous neighbors
+    first); owners "behind" the wrap are future blocks and contribute
+    nothing (their merge weight is exp(-inf) = 0)."""
+    # hops back to reach the band floor of a q block's FIRST position:
+    # lowest visible k = i*t_local - window + 1 → owner i - ceil((w-1)/tl)
+    n_steps = min(n_ring, -(-(window - 1) // t_local) + 1)
+    # send i → i+1, so each device RECEIVES its predecessor's block
+    perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
+
+    def body(q_blk, k_blk, v_blk):
+        my_idx = lax.axis_index(axis_name)
+        b, tq, h, dd = q_blk.shape
+        o = jnp.zeros((b, tq, h, dd), jnp.float32)
+        m = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+        l = jnp.zeros((b, h, tq), jnp.float32)
+
+        def step(s, carry):
+            o, m, l, kc, vc = carry
+            k_owner = (my_idx - s) % n_ring
+
+            def compute(_):
+                return _block_attn(
+                    q_blk, kc, vc,
+                    q_offset=my_idx * t_local,
+                    k_offset=k_owner * t_local,
+                    causal=True, scale=scale, window=window)
+
+            def skip(_):
+                return (jnp.zeros((b, tq, h, dd), jnp.float32),
+                        jnp.full((b, h, tq), NEG_INF, jnp.float32),
+                        jnp.zeros((b, h, tq), jnp.float32))
+
+            # wrapped owners sit in the causal future of every local q
+            pv, m_blk, l_blk = lax.cond(k_owner <= my_idx, compute, skip,
+                                        None)
+            m_new = jnp.maximum(m, m_blk)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_blk - m_new)
+            l_new = l * alpha + l_blk * beta
+            o_new = (o * jnp.swapaxes(alpha, 1, 2)[..., None]
+                     + pv * jnp.swapaxes(beta, 1, 2)[..., None])
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            return (o_new, m_new, l_new, kc, vc)
+
+        o, m, l, _, _ = lax.fori_loop(
+            0, n_steps, step, (o, m, l, k_blk.astype(jnp.float32),
+                               v_blk.astype(jnp.float32)))
+        denom = jnp.maximum(jnp.swapaxes(l, 1, 2)[..., None], 1e-30)
+        return (o / denom).astype(q_blk.dtype)
+
+    spec = P(None, axis_name, None, None)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 def ring_self_attention_sharded(mesh: Mesh):

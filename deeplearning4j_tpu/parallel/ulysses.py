@@ -26,32 +26,40 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.ops.attention import NEG_INF, causal_band_mask
 from deeplearning4j_tpu.parallel.mesh import SEQUENCE_AXIS
 
 
-def _local_attention(q, k, v, *, causal: bool, t_offset_q=0):
-    """Plain softmax attention on full-sequence blocks [B, T, h, D]."""
+def _local_attention(q, k, v, *, causal: bool, t_offset_q=0, window=None):
+    """Plain softmax attention on full-sequence blocks [B, T, h, D].
+    ``window`` (requires causal) keeps k in ``(q - window, q]`` via the
+    shared ``ops.attention.causal_band_mask``."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
     if causal:
-        tq, tk = q.shape[1], k.shape[1]
-        mask = (jnp.arange(tq)[:, None] + t_offset_q
-                >= jnp.arange(tk)[None, :])
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        mask = causal_band_mask(q.shape[1], k.shape[1], window=window,
+                                q_offset=t_offset_q)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = False,
-                      axis_name: str = SEQUENCE_AXIS):
+                      axis_name: str = SEQUENCE_AXIS, window=None):
     """Self-attention over sequence-sharded [B, T, H, D] inputs.
 
     ``H`` must be divisible by the sequence-axis size (each device owns
-    H/P heads during the compute phase).
+    H/P heads during the compute phase). ``window`` (requires causal)
+    applies sliding-window masking inside the local full-sequence
+    attention — the all-to-alls are unchanged.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
+    if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        return _local_attention(q, k, v, causal=causal, window=window)
     n_seq = mesh.shape[axis_name]
     if q.shape[2] % n_seq:
         raise ValueError(
@@ -72,7 +80,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = False,
         qh = seq_to_head(q_blk)
         kh = seq_to_head(k_blk)
         vh = seq_to_head(v_blk)
-        out = _local_attention(qh, kh, vh, causal=causal)
+        out = _local_attention(qh, kh, vh, causal=causal, window=window)
         return head_to_seq(out)
 
     spec = P(None, axis_name, None, None)

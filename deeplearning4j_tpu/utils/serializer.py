@@ -53,6 +53,12 @@ def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
             sub_prefix = f"{prefix}/{comp}" if prefix else comp
             out.update(_flatten_tree(item, sub_prefix))
     else:
+        if getattr(tree, "is_fully_addressable", True) is False:
+            raise ValueError(
+                f"cannot serialize leaf {prefix!r}: array is sharded "
+                "across hosts (not fully addressable). Gather with "
+                "jax.experimental.multihost_utils.process_allgather and "
+                "write from process 0.")
         out[prefix] = np.asarray(tree)
     return out
 
@@ -90,6 +96,13 @@ def _unflatten_tree(flat: Dict[str, np.ndarray]) -> Any:
 
 
 def _write_npz(zf: zipfile.ZipFile, name: str, tree: Any) -> None:
+    """Serialize a param tree. Sharded-but-single-host arrays (TP/FSDP on
+    one host) are gathered to full host tensors here — correct, but the
+    full model must fit host RAM. Arrays that are NOT fully addressable
+    (multi-host meshes) cannot be gathered by np.asarray at all; raise a
+    targeted error instead of np's cryptic one. Multi-host checkpointing
+    should gather via jax.experimental.multihost_utils (process-0 writes)
+    before calling the serializer."""
     flat = _flatten_tree(tree)
     buf = io.BytesIO()
     np.savez(buf, **flat)

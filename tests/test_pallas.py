@@ -182,6 +182,31 @@ class TestFlashBackwardPallas:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=1e-4, atol=1e-4)
 
+    def test_bf16_kernel_vs_precise_scan_oracle(self):
+        """Advisor r4: with BOTH sides casting matmul operands to bf16, a
+        shared precision bug class cancels out. precise=True keeps the
+        scan oracle's operands in f32, so the kernels are checked against
+        a genuinely higher-precision independent implementation."""
+        from deeplearning4j_tpu.pallas.flash_attention import (
+            flash_attention_fwd, flash_backward, flash_backward_pallas)
+
+        q, k, v = _qkv(1, 128, 2, 32, seed=21)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        do = jnp.asarray(
+            np.random.default_rng(22).normal(size=q.shape), jnp.float32)
+        out, lse = flash_attention_fwd(qb, kb, vb, causal=True,
+                                       block_q=64, block_k=64)
+        oracle = flash_backward(qb, kb, vb, out, lse, do, causal=True,
+                                precise=True)
+        # oracle operands really ran in f32
+        assert oracle[0].dtype == jnp.float32
+        got = flash_backward_pallas(qb, kb, vb, out, lse, do, causal=True,
+                                    block_q=64, block_k=64)
+        for a, b in zip(oracle, got):
+            np.testing.assert_allclose(
+                np.asarray(b, np.float32), np.asarray(a, np.float32),
+                rtol=0.05, atol=0.05)
+
     def test_bf16_operands(self):
         from deeplearning4j_tpu.pallas.flash_attention import flash_attention
 

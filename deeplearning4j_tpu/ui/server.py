@@ -26,6 +26,11 @@ Endpoints (all JSON unless noted):
   GET  /nearestneighbors?word=w&k=5 VPTree k-NN                (NearestNeighborsResource)
   POST /api/update?sid=S            free-form payload          (ApiResource)
   GET  /api/data?sid=S
+  POST /renders/update              {path: ...} repoint render (RendersResource)
+  GET  /renders/img                 current render PNG (auto-tracks the
+                                    latest activation tile)
+  POST /uploads/upload              {filename, content_b64}    (FileResource)
+  GET  /uploads/<name>              serve an uploaded file back
   GET  /sessions                    known session ids
   GET  /                            dashboard (text/html)
 """
@@ -58,6 +63,20 @@ class UiServer:
         self._nn_labels: List[str] = []
         self._nn_vectors: Optional[np.ndarray] = None
         self._nn_tree = None
+        # renders (RendersResource.java:43): the latest activation tile
+        # is kept as in-memory PNG bytes (no per-iteration disk write);
+        # POST /renders/update can repoint at a file, but ONLY inside
+        # upload_dir — the reference allowed any path, which on a
+        # non-localhost bind is an arbitrary-file-read hole
+        self._render_bytes: Optional[bytes] = None
+        self.render_path: Optional[str] = None
+        # uploads land in a per-server temp dir (FileResource.java:45
+        # defaults to java.io.tmpdir); upload_handler mirrors the
+        # abstract handleUpload(File) hook (FileResource.java:111)
+        import tempfile
+
+        self.upload_dir = tempfile.mkdtemp(prefix="dl4j_tpu_ui_uploads_")
+        self.upload_handler = None  # Optional[Callable[[str], None]]
         server = self  # close over for the handler
 
         class Handler(BaseHTTPRequestHandler):
@@ -128,6 +147,33 @@ class UiServer:
             self.history.append(sid, "weights", _weights_history_row(payload))
         else:
             self.history.append(sid, kind, payload)
+        if kind == "activations":
+            self._capture_render(payload)
+
+    def _capture_render(self, payload: Any) -> None:
+        """Keep the listener's latest conv-activation tile as in-memory
+        PNG bytes so /renders/img serves it with zero disk I/O
+        (RendersResource parity without the reference's file round-trip)."""
+        import base64
+
+        img = (payload or {}).get("image", "")
+        marker = ";base64,"
+        if not isinstance(img, str) or marker not in img:
+            return
+        try:
+            self._render_bytes = base64.b64decode(img.split(marker, 1)[1])
+        except (ValueError, IndexError):
+            pass
+
+    def _resolve_upload(self, path: str) -> Optional[str]:
+        """realpath-confine ``path`` to upload_dir; None if it escapes."""
+        import os
+
+        real = os.path.realpath(
+            path if os.path.isabs(path)
+            else os.path.join(self.upload_dir, path))
+        root = os.path.realpath(self.upload_dir)
+        return real if real.startswith(root + os.sep) else None
 
     def upload_vectors(self, labels: List[str], vectors) -> None:
         """Load word vectors for the nearest-neighbors endpoint."""
@@ -181,6 +227,37 @@ class UiServer:
             word = q.get("word", [""])[0]
             k = int(q.get("k", ["5"])[0])
             h._send(self.nearest(word, k))
+        elif route == "/renders/img":
+            # serve the current render image (RendersResource.java:54-57
+            # GET /filters/img): the latest activation tile from memory,
+            # unless POST /renders/update repointed at an uploaded file
+            import os
+
+            if self.render_path is not None:
+                path = self._resolve_upload(self.render_path)
+                if path is None or not os.path.isfile(path):
+                    h._send({"error": "no render at the configured path"},
+                            status=404)
+                else:
+                    with open(path, "rb") as f:
+                        h._send(f.read(), content_type="image/png")
+            elif self._render_bytes is not None:
+                h._send(self._render_bytes, content_type="image/png")
+            else:
+                h._send({"error": "no render yet"}, status=404)
+        elif route.startswith("/uploads/"):
+            # GET /uploads/<name> serves an uploaded file back
+            # (FileResource.java:47-50 GET /{path})
+            import os
+
+            name = os.path.basename(route[len("/uploads/"):])
+            target = os.path.join(self.upload_dir, name)
+            if not name or not os.path.isfile(target):
+                h._send({"error": "not found"}, status=404)
+            else:
+                with open(target, "rb") as f:
+                    h._send(f.read(),
+                            content_type="application/octet-stream")
         else:
             h._send({"error": "not found"}, status=404)
 
@@ -202,6 +279,45 @@ class UiServer:
         elif route == "/nearestneighbors/upload":
             self.upload_vectors(payload["labels"], payload["vectors"])
             h._send({"status": "ok", "count": len(payload["labels"])})
+        elif route == "/renders/update":
+            # {"path": "..."} repoints the render image
+            # (RendersResource.java:45-49 POST /filters/update). The path
+            # must resolve inside upload_dir (upload the file first via
+            # /uploads/upload); anything else is rejected — the reference
+            # accepted arbitrary paths, which is a file-read hole on a
+            # non-localhost bind. {"path": null} reverts to the live
+            # activation-tile bytes.
+            raw = payload.get("path")
+            if raw is None:
+                self.render_path = None
+                h._send({"status": "ok", "path": None})
+                return
+            resolved = self._resolve_upload(str(raw))
+            if resolved is None:
+                h._send({"error": "path must be inside the upload dir"},
+                        status=403)
+                return
+            self.render_path = resolved
+            h._send({"status": "ok", "path": resolved})
+        elif route == "/uploads/upload":
+            # JSON {"filename": ..., "content_b64": ...} — the stdlib
+            # server speaks JSON, not multipart; the semantics match
+            # FileResource.java:78-88 (write under the upload dir, fire
+            # the handler, echo the landed location)
+            import base64
+            import os
+
+            name = os.path.basename(str(payload.get("filename", "")))
+            if not name:
+                h._send({"error": "filename required"}, status=400)
+                return
+            data = base64.b64decode(payload.get("content_b64", ""))
+            target = os.path.join(self.upload_dir, name)
+            with open(target, "wb") as f:
+                f.write(data)
+            if self.upload_handler is not None:
+                self.upload_handler(target)
+            h._send({"status": "ok", "path": target, "bytes": len(data)})
         else:
             h._send({"error": "not found"}, status=404)
 

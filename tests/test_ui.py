@@ -130,6 +130,87 @@ def test_conv_listener_posts_png(server, rng):
     assert act["shape"][0] == 2  # max_rows examples tiled
 
 
+def _get_raw(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read(), r.headers.get("Content-Type")
+
+
+def test_renders_endpoint_serves_latest_activation_tile(server, rng):
+    """GET /renders/img (RendersResource.java:54-57 parity): after a conv
+    listener posts an activation tile, the render endpoint serves it as
+    a real PNG file."""
+    conf = (
+        NeuralNetConfiguration.Builder().seed(0).learning_rate(0.01).list()
+        .layer(0, L.ConvolutionLayer(n_in=1, n_out=4, kernel_size=(3, 3),
+                                     stride=(1, 1), activation="relu"))
+        .layer(1, L.OutputLayer(n_in=4 * 26 * 26, n_out=10))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ConvolutionalIterationListener(
+        server=server, session_id="render-test", frequency=1, max_rows=2))
+    x = rng.random((4, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    net.fit(DataSet(x, y))
+    body, ctype = _get_raw(f"{server.url}/renders/img")
+    assert ctype == "image/png"
+    assert body.startswith(b"\x89PNG\r\n\x1a\n")
+
+
+def test_renders_update_repoints_path(server):
+    """POST /renders/update (RendersResource.java:45-49 parity) — the
+    target must live in the upload dir (upload-then-repoint flow);
+    arbitrary filesystem paths are refused (403), closing the
+    file-read hole the reference's unrestricted imagePath had."""
+    import base64
+
+    png = encode_png_gray(np.zeros((4, 4), np.uint8))
+    _post(f"{server.url}/uploads/upload",
+          {"filename": "custom.png",
+           "content_b64": base64.b64encode(png).decode()})
+    out = _post(f"{server.url}/renders/update", {"path": "custom.png"})
+    assert out["status"] == "ok"
+    body, ctype = _get_raw(f"{server.url}/renders/img")
+    assert ctype == "image/png" and body == png
+    # escaping the upload dir → 403; traversal inside it → 403 too
+    for bad in ("/etc/passwd", "../../../etc/passwd"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{server.url}/renders/update", {"path": bad})
+        assert ei.value.code == 403
+    # missing file inside the dir → 404, not a hang or 500
+    _post(f"{server.url}/renders/update", {"path": "gone.png"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_raw(f"{server.url}/renders/img")
+    assert ei.value.code == 404
+    # revert to the live activation-tile bytes
+    out = _post(f"{server.url}/renders/update", {"path": None})
+    assert out["path"] is None
+
+
+def test_uploads_roundtrip_and_handler(server):
+    """POST /uploads/upload + GET /uploads/<name>
+    (FileResource.java:47-88 parity, JSON transport)."""
+    import base64
+
+    seen = []
+    server.upload_handler = seen.append
+    try:
+        payload = {"filename": "weights.bin",
+                   "content_b64": base64.b64encode(b"\x00\x01abc").decode()}
+        out = _post(f"{server.url}/uploads/upload", payload)
+        assert out["status"] == "ok" and out["bytes"] == 5
+        assert seen and seen[0].endswith("weights.bin")
+        body, _ = _get_raw(f"{server.url}/uploads/weights.bin")
+        assert body == b"\x00\x01abc"
+        # traversal attempts collapse to basename; absent names 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_raw(f"{server.url}/uploads/no_such_file")
+        assert ei.value.code == 404
+    finally:
+        server.upload_handler = None
+
+
 def test_nearest_neighbors_endpoint(server, rng):
     vecs = np.eye(4, dtype=np.float32) + 0.01 * rng.normal(size=(4, 4))
     labels = ["alpha", "beta", "gamma", "delta"]

@@ -129,20 +129,31 @@ class Tree:
     # -- transforms ----------------------------------------------------
     def binarize(self) -> "Tree":
         """Right-binarize n-ary nodes so every internal node has exactly two
-        children (the RNTN composition is strictly binary)."""
+        children (the RNTN composition is strictly binary). Syntactic
+        ``tag``s survive (HeadWordFinder and the treebank parser's grammar
+        extraction both read them)."""
         if self.is_leaf:
-            return Tree(label=self.label, word=self.word)
+            return Tree(label=self.label, word=self.word, tag=self.tag)
         kids = [c.binarize() for c in self.children]
         if len(kids) == 1:
-            # unary collapse: keep the child but adopt this node's label
+            # unary collapse: adopt this node's label (span semantics,
+            # e.g. sentiment); for TAGS a collapsed preterminal keeps the
+            # child's POS (DT/NN/VBD carry the lexical information the
+            # grammar and head rules need), otherwise the parent category
             child = kids[0]
+            if child.word is not None and child.tag is not None:
+                tag = child.tag
+            else:
+                tag = self.tag if self.tag is not None else child.tag
             return Tree(label=self.label if self.label is not None
                         else child.label,
-                        word=child.word, children=child.children)
+                        tag=tag, word=child.word, children=child.children)
         node = kids[-1]
         for left in reversed(kids[1:-1]):
-            node = Tree(label=self.label, children=[left, node])
-        return Tree(label=self.label, children=[kids[0], node])
+            node = Tree(label=self.label, tag=self.tag,
+                        children=[left, node])
+        return Tree(label=self.label, tag=self.tag,
+                    children=[kids[0], node])
 
     # -- device program ------------------------------------------------
     def linearize(self, word_index: Dict[str, int],

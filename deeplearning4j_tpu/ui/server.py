@@ -37,7 +37,11 @@ Endpoints (all JSON unless noted):
 
 from __future__ import annotations
 
+import base64
+import binascii
 import json
+import os
+import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
@@ -73,8 +77,6 @@ class UiServer:
         # uploads land in a per-server temp dir (FileResource.java:45
         # defaults to java.io.tmpdir); upload_handler mirrors the
         # abstract handleUpload(File) hook (FileResource.java:111)
-        import tempfile
-
         self.upload_dir = tempfile.mkdtemp(prefix="dl4j_tpu_ui_uploads_")
         self.upload_handler = None  # Optional[Callable[[str], None]]
         server = self  # close over for the handler
@@ -133,8 +135,11 @@ class UiServer:
         return f"http://{host}:{port}"
 
     def stop(self) -> None:
+        import shutil
+
         self._httpd.shutdown()
         self._httpd.server_close()
+        shutil.rmtree(self.upload_dir, ignore_errors=True)
         with UiServer._instance_lock:
             if UiServer._instance is self:
                 UiServer._instance = None
@@ -154,8 +159,6 @@ class UiServer:
         """Keep the listener's latest conv-activation tile as in-memory
         PNG bytes so /renders/img serves it with zero disk I/O
         (RendersResource parity without the reference's file round-trip)."""
-        import base64
-
         img = (payload or {}).get("image", "")
         marker = ";base64,"
         if not isinstance(img, str) or marker not in img:
@@ -167,8 +170,6 @@ class UiServer:
 
     def _resolve_upload(self, path: str) -> Optional[str]:
         """realpath-confine ``path`` to upload_dir; None if it escapes."""
-        import os
-
         real = os.path.realpath(
             path if os.path.isabs(path)
             else os.path.join(self.upload_dir, path))
@@ -231,8 +232,6 @@ class UiServer:
             # serve the current render image (RendersResource.java:54-57
             # GET /filters/img): the latest activation tile from memory,
             # unless POST /renders/update repointed at an uploaded file
-            import os
-
             if self.render_path is not None:
                 path = self._resolve_upload(self.render_path)
                 if path is None or not os.path.isfile(path):
@@ -248,8 +247,6 @@ class UiServer:
         elif route.startswith("/uploads/"):
             # GET /uploads/<name> serves an uploaded file back
             # (FileResource.java:47-50 GET /{path})
-            import os
-
             name = os.path.basename(route[len("/uploads/"):])
             target = os.path.join(self.upload_dir, name)
             if not name or not os.path.isfile(target):
@@ -304,14 +301,19 @@ class UiServer:
             # server speaks JSON, not multipart; the semantics match
             # FileResource.java:78-88 (write under the upload dir, fire
             # the handler, echo the landed location)
-            import base64
-            import os
-
             name = os.path.basename(str(payload.get("filename", "")))
             if not name:
                 h._send({"error": "filename required"}, status=400)
                 return
-            data = base64.b64decode(payload.get("content_b64", ""))
+            try:
+                # validate=True: reject (not silently drop) stray chars,
+                # so the stored bytes are exactly what the client sent
+                data = base64.b64decode(str(payload.get("content_b64", "")),
+                                        validate=True)
+            except (binascii.Error, ValueError) as e:
+                h._send({"error": f"invalid base64 content: {e}"},
+                        status=400)
+                return
             target = os.path.join(self.upload_dir, name)
             with open(target, "wb") as f:
                 f.write(data)

@@ -67,6 +67,67 @@ class TestTree:
         with pytest.raises(ValueError):
             t.linearize(build_word_index([t]), max_nodes=3)
 
+    def test_treebank_parser_raw_sentences(self):
+        """TreebankParser (TreeParser.java:427 capability): fit a PCFG on
+        SST-style trees, then parse RAW sentences — including OOV words —
+        into trees the RNTN pipeline can linearize."""
+        from deeplearning4j_tpu.nlp.treeparser import TreebankParser
+        from deeplearning4j_tpu.nlp.trees import Tree, build_word_index
+
+        bank = [Tree.parse(s) for s in [
+            "(3 (2 (2 the) (2 movie)) (3 (2 was) (3 great)))",
+            "(1 (2 (2 the) (2 film)) (1 (2 was) (1 awful)))",
+            "(3 (2 (2 the) (2 plot)) (3 (2 was) (3 fun)))",
+            "(2 (2 the) (2 movie))",
+            # extra 3→(3,2) rule breaks the balanced-vs-right-branching
+            # derivation tie for the sentences below (P(3→(2,3)) < 1)
+            "(3 (3 good) (2 stuff))",
+        ]]
+        parser = TreebankParser().fit(bank)
+
+        t = parser.parse("the movie was great")
+        assert t.words() == ["the", "movie", "was", "great"]
+        # a grammar derivation was found (NOT the right-branching
+        # fallback, whose left child is always a bare leaf): strictly
+        # binary with the root symbol carried into the SST-style label
+        assert len(t.children) == 2
+        assert not t.children[0].is_leaf
+        assert all(len(n.children) == 2
+                   for n in t.post_order() if not n.is_leaf)
+        assert t.label == 3
+
+        # OOV adjective scores against the UNK distribution and parses
+        t2 = parser.parse("the film was stupendous")
+        assert t2.words() == ["the", "film", "was", "stupendous"]
+        assert len(t2.children) == 2
+
+        # unfitted parser degrades to the fallback
+        t4 = TreebankParser().parse_tokens(["a", "b"])
+        assert t4.words() == ["a", "b"]
+
+        # output linearizes for the device evaluator unchanged
+        idx = build_word_index(bank)
+        prog = t.linearize(idx, max_nodes=16)
+        assert int(prog["n_nodes"]) == 7
+
+    def test_treebank_parser_keeps_ptb_tags(self):
+        from deeplearning4j_tpu.nlp.treeparser import TreebankParser
+        from deeplearning4j_tpu.nlp.trees import Tree
+
+        bank = [Tree.parse("(S (NP (DT the) (NN cat)) (VP (VBD sat)))")] * 3
+        parser = TreebankParser().fit(bank)
+        t = parser.parse_tokens(["the", "cat", "sat"])
+        assert t.tag == "S"
+        assert t.children[0].tag == "NP"
+        assert [leaf.tag for leaf in t.leaves()] == ["DT", "NN", "VBD"]
+        # this grammar derives only 3-token sentences (S→NP VP, NP→DT NN):
+        # a 4-token input has NO derivation — the empty-chart fallback
+        # must produce the right-branching shape, not fail
+        t4 = parser.parse_tokens(["the", "cat", "sat", "sat"])
+        assert t4.words() == ["the", "cat", "sat", "sat"]
+        assert t4.children[0].is_leaf and t4.children[0].word == "the"
+        assert t4.tag is None  # fallback carries labels, not grammar tags
+
     def test_pad_to_bucket(self):
         assert pad_to_bucket(3) == 8
         assert pad_to_bucket(9) == 16

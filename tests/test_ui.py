@@ -207,6 +207,11 @@ def test_uploads_roundtrip_and_handler(server):
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get_raw(f"{server.url}/uploads/no_such_file")
         assert ei.value.code == 404
+        # malformed base64 → 400 with a clear message, not a 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{server.url}/uploads/upload",
+                  {"filename": "bad.bin", "content_b64": "!!!"})
+        assert ei.value.code == 400
     finally:
         server.upload_handler = None
 

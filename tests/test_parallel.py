@@ -220,16 +220,44 @@ class TestWindowedRingAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=5e-5)
 
-    def test_flash_impl_uses_banded_fallback(self):
-        """impl="flash" with a window trains via the documented blockwise
-        fallback — same numbers, no error."""
+    # windows chosen to hit every hop-kind mix: w=5 (diag-only band edge),
+    # w=8 (= t_local: diag + one edge hop), w=20 (diag + full + partial),
+    # w=64 (band covers the whole ring)
+    @pytest.mark.parametrize("window", [5, 8, 20, 64])
+    def test_flash_impl_matches_windowed_reference(self, window):
+        """impl="flash" with a window runs the Pallas kernels per hop
+        (static per-hop offsets from the unrolled reversed ring)."""
         q, k, v = self._qkv()
         mesh = build_mesh(MeshSpec(data=1, sequence=8))
-        ref = dot_product_attention(q, k, v, causal=True, window=10)
-        out = ring_attention(q, k, v, mesh, causal=True, window=10,
+        ref = dot_product_attention(q, k, v, causal=True, window=window)
+        out = ring_attention(q, k, v, mesh, causal=True, window=window,
                              impl="flash")
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    rtol=2e-4, atol=2e-5)
+
+    # w=8 → 2 of 8 hops; w=20 → 4; w=50 → capped n_steps == n_ring, the
+    # only case where the backward home-shift equals n_ring-1
+    @pytest.mark.parametrize("window", [8, 20, 50])
+    def test_flash_impl_windowed_gradients(self, window):
+        """The windowed flash-ring custom_vjp (per-hop trichotomy + the
+        explicit dk/dv trip home) must match autodiff through the
+        single-device windowed reference."""
+        q, k, v = self._qkv(t=64, h=2, d=32)
+        mesh = build_mesh(MeshSpec(data=1, sequence=8))
+
+        def ring_loss(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh, causal=True,
+                                          window=window, impl="flash") ** 2)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True,
+                                                 window=window) ** 2)
+
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
 
     def test_window_requires_causal(self):
         q, k, v = self._qkv(t=16)

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nlp.word2vec_iterator import (  # noqa: F401
 from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
 from deeplearning4j_tpu.nlp.trees import Tree, build_word_index  # noqa: F401
 from deeplearning4j_tpu.nlp.treeparser import TreebankParser  # noqa: F401
+from deeplearning4j_tpu.nlp.postagger import HmmPosTagger  # noqa: F401
 from deeplearning4j_tpu.nlp.viterbi import Viterbi  # noqa: F401
 from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex  # noqa: F401
 from deeplearning4j_tpu.nlp.sentiwordnet import SWN3  # noqa: F401

@@ -69,6 +69,7 @@ class TreebankParser:
         # inverted to (left, right) -> [(parent, logp)] for CKY lookups
         self.binary: Dict[Tuple[str, str], List[Tuple[str, float]]] = {}
         self.root_logp: Dict[str, float] = {}
+        self._vocab: set = set()
         self._fitted = False
 
     # -- training ------------------------------------------------------
@@ -91,6 +92,8 @@ class TreebankParser:
                     bin_counts[_symbol(node)][
                         (_symbol(left), _symbol(right))] += 1.0
 
+        self._vocab = {w for words in lex_counts.values()
+                       for w, c in words.items() if c >= self.min_count}
         # lexical: rare words fold into *UNK* per preterminal symbol
         self.lexical = {}
         for sym, words in lex_counts.items():
@@ -132,22 +135,38 @@ class TreebankParser:
                 out[sym] = lp
         return out
 
-    def parse_tokens(self, tokens: Sequence[str],
-                     label: int = 0) -> Tree:
+    def parse_tokens(self, tokens: Sequence[str], label: int = 0,
+                     tagger=None) -> Tree:
         """CKY Viterbi parse; right-branching fallback when the grammar
-        admits no complete derivation (or the parser is unfitted)."""
+        admits no complete derivation (or the parser is unfitted).
+
+        ``tagger`` (an :class:`~deeplearning4j_tpu.nlp.postagger.
+        HmmPosTagger` trained on the same tag set) constrains
+        OUT-OF-VOCABULARY words to the tagger's predicted preterminal
+        instead of the uniform unknown-word sweep over every symbol —
+        the tagger→parser pipeline the reference built from OpenNLP
+        pieces. In-vocabulary words keep their lexical distributions;
+        a predicted tag the grammar has never seen falls back to the
+        unconstrained sweep."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty token list")
         if not self._fitted:
             return Tree.from_tokens(tokens, label=label)
+        predicted = None
+        if tagger is not None:
+            predicted = [t for _, t in tagger.tag_tokens(tokens)]
         n = len(tokens)
         # chart[i][j]: span tokens[i:j] → {sym: (logp, backpointer)}
         # backpointer: None for leaves, (split, lsym, rsym) otherwise
         chart: List[List[Dict[str, Tuple[float, Optional[tuple]]]]] = [
             [dict() for _ in range(n + 1)] for _ in range(n)]
         for i, w in enumerate(tokens):
-            for sym, lp in self._lex_scores(w).items():
+            scores = self._lex_scores(w)
+            if predicted is not None and w not in self._vocab \
+                    and predicted[i] in scores:
+                scores = {predicted[i]: scores[predicted[i]]}
+            for sym, lp in scores.items():
                 chart[i][i + 1][sym] = (lp, None)
         for width in range(2, n + 1):
             for i in range(0, n - width + 1):
@@ -184,13 +203,14 @@ class TreebankParser:
                          self._build(chart, tokens, split, j, rs)]
         return node
 
-    def parse(self, sentence: str, label: int = 0) -> Tree:
+    def parse(self, sentence: str, label: int = 0, tagger=None) -> Tree:
         """Raw sentence → tree (TreeParser.java:427 getTrees entry)."""
         from deeplearning4j_tpu.nlp.tokenization import (
             DefaultTokenizerFactory)
 
         tokens = DefaultTokenizerFactory().create(sentence).get_tokens()
-        return self.parse_tokens(tokens, label=label)
+        return self.parse_tokens(tokens, label=label, tagger=tagger)
 
-    def parse_many(self, sentences: Sequence[str]) -> List[Tree]:
-        return [self.parse(s) for s in sentences]
+    def parse_many(self, sentences: Sequence[str],
+                   tagger=None) -> List[Tree]:
+        return [self.parse(s, tagger=tagger) for s in sentences]

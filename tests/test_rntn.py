@@ -128,6 +128,75 @@ class TestTree:
         assert t4.children[0].is_leaf and t4.children[0].word == "the"
         assert t4.tag is None  # fallback carries labels, not grammar tags
 
+    def test_hmm_pos_tagger(self):
+        """HmmPosTagger (OpenNLP POS-pipeline capability): fit on tagged
+        sentences, decode raw text on-device — OOV words ride shape
+        features and the singleton-UNK distribution."""
+        from deeplearning4j_tpu.nlp.postagger import HmmPosTagger
+
+        corpus = [
+            [("the", "DT"), ("cat", "NN"), ("sat", "VBD")],
+            [("the", "DT"), ("dog", "NN"), ("ran", "VBD")],
+            [("a", "DT"), ("cat", "NN"), ("ran", "VBD")],
+            [("the", "DT"), ("dog", "NN"), ("sat", "VBD")],
+            [("cats", "NNS"), ("run", "VBP")],
+            [("dogs", "NNS"), ("sit", "VBP")],
+        ] * 3
+        tagger = HmmPosTagger().fit(corpus)
+        out = tagger.tag("the cat ran")
+        assert [t for _, t in out] == ["DT", "NN", "VBD"]
+        # OOV noun in a known frame: transition structure carries it
+        out2 = tagger.tag_tokens(["the", "wombat", "sat"])
+        assert [t for _, t in out2] == ["DT", "NN", "VBD"]
+        # plural shape feature routes an OOV *S* word toward NNS
+        out3 = tagger.tag_tokens(["wombats", "run"])
+        assert out3[0][1] == "NNS"
+        with pytest.raises(RuntimeError):
+            HmmPosTagger().tag_tokens(["x"])
+        # blank sentences (blank lines in word/TAG files) are skipped
+        t2 = HmmPosTagger().fit([[], [("a", "DT")], []])
+        assert t2.tag_tokens(["a"])[0][1] == "DT"
+        with pytest.raises(ValueError, match="non-empty"):
+            HmmPosTagger().fit([[], []])
+
+    def test_shape_backoff_not_outscored_by_unshaped_tags(self):
+        """Advisor r5: shape buckets hold a SUBSET of a tag's UNK mass.
+        A tag with many non-plural singletons must not outscore the
+        plural tag on an OOV plural just because it falls back to its
+        FULL UNK mass while NNS uses the smaller *S* bucket. Transitions
+        here are neutral (single-word sentences), so emissions decide."""
+        from deeplearning4j_tpu.nlp.postagger import HmmPosTagger
+
+        corpus = [[(w, "NN")] for w in
+                  ("ant", "bee", "cow", "elk", "fox", "gnu",
+                   "hen", "owl", "pig", "ram")]
+        corpus += [[(w, "NNS")] for w in ("ants", "bees", "cows")]
+        tagger = HmmPosTagger().fit(corpus)
+        assert tagger.tag_tokens(["wombats"])[0][1] == "NNS"
+
+    def test_pos_tagger_from_treebank_feeds_parser(self):
+        """Treebank → tagger + parser from the same trees: the full
+        raw-text pipeline the reference built from UIMA pieces."""
+        from deeplearning4j_tpu.nlp.postagger import HmmPosTagger
+        from deeplearning4j_tpu.nlp.treeparser import TreebankParser
+        from deeplearning4j_tpu.nlp.trees import Tree
+
+        bank = [Tree.parse("(S (NP (DT the) (NN cat)) (VP (VBD sat)))"),
+                Tree.parse("(S (NP (DT a) (NN dog)) (VP (VBD ran)))")] * 2
+        tagger = HmmPosTagger.from_treebank(bank)
+        assert [t for _, t in tagger.tag_tokens(["the", "dog", "sat"])] \
+            == ["DT", "NN", "VBD"]
+        parser = TreebankParser().fit(bank)
+        tree = parser.parse_tokens(["a", "cat", "ran"])
+        assert tree.tag == "S" and tree.children[0].tag == "NP"
+        # the integrated pipeline: an OOV word's preterminal candidates
+        # collapse to the tagger's prediction instead of the UNK sweep
+        tree2 = parser.parse_tokens(["the", "wombat", "ran"],
+                                    tagger=tagger)
+        assert tree2.tag == "S"
+        leaf_tags = [leaf.tag for leaf in tree2.leaves()]
+        assert leaf_tags == ["DT", "NN", "VBD"]
+
     def test_pad_to_bucket(self):
         assert pad_to_bucket(3) == 8
         assert pad_to_bucket(9) == 16

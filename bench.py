@@ -329,12 +329,13 @@ def bench_infeed():
 
 
 def _transformer(t, vocab=8192, d=512, layers=8, heads=8, attn="auto",
-                 remat=False):
+                 remat=False, window=None):
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
     return TransformerLM(vocab_size=vocab, d_model=d, num_heads=heads,
                          num_layers=layers, max_len=t, seed=0,
-                         dtype_policy="bf16", attn_impl=attn, remat=remat)
+                         dtype_policy="bf16", attn_impl=attn, remat=remat,
+                         attn_window=window)
 
 
 def _transformer_flops_per_token(lm, t):
@@ -342,14 +343,18 @@ def _transformer_flops_per_token(lm, t):
         int(np.prod(p.shape)) for blk in lm.params["blocks"]
         for grp in blk.values() for p in grp.values())
     n_params_matmul += lm.d_model * lm.vocab_size  # tied unembedding
-    return 6 * n_params_matmul + 12 * lm.num_layers * lm.d_model * t // 2
+    # attention term: avg keys/query is t/2 causal, ~window when banded
+    # (keeps windowed-config MFU honest — banding REMOVES model FLOPs)
+    avg_keys = (t // 2 if lm.attn_window is None
+                else min(t // 2, lm.attn_window))
+    return 6 * n_params_matmul + 12 * lm.num_layers * lm.d_model * avg_keys
 
 
 def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
-                           remat=False):
+                           remat=False, window=None):
     import jax.numpy as jnp
 
-    lm = _transformer(t, attn=attn, remat=remat).init()
+    lm = _transformer(t, attn=attn, remat=remat, window=window).init()
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
     _sync(tokens)
@@ -425,6 +430,22 @@ def bench_transformer(cpu_baseline=True):
         flash_cfg = {"error": str(e)[:200]}
         _log(f"transformer t4096 FAILED: {e}")
 
+    # sliding-window at the same long-context shape: the banded flash
+    # grid does O(t·window) work instead of O(t²/2) — the recorded
+    # tokens/sec ratio vs the full-causal t4096 entry is the artifact
+    # evidence for the banded kernels (window=1024 ⇒ ~2x fewer
+    # attention FLOPs at t=4096)
+    try:
+        win_cfg, _, _ = _bench_transformer_cfg(4, 4096, steps=6, fused_k=6,
+                                               attn="flash", window=1024)
+        win_cfg["note"] = "banded flash grid, attn_window=1024"
+        _log(f"transformer b4 t4096 w1024 (flash banded): "
+             f"{win_cfg['tokens_per_sec']:,.0f} tok/s "
+             f"({win_cfg['mfu_pct']:.1f}% MFU)")
+    except Exception as e:
+        win_cfg = {"error": str(e)[:200]}
+        _log(f"transformer t4096 w1024 FAILED: {e}")
+
     # vs_baseline is strictly like-for-like: the b16 t1024 TPU number over
     # the SAME config on XLA-CPU (the sweep's best batch may differ)
     b16_tps = (sweep.get("16") or {}).get("tokens_per_sec", 0.0) or 0.0
@@ -464,6 +485,7 @@ def bench_transformer(cpu_baseline=True):
     result["config"] = "d512 L8 H8 v8192 bf16"
     result["batch_sweep_t1024"] = sweep
     result["long_context_t4096"] = flash_cfg
+    result["long_context_t4096_w1024"] = win_cfg
     return result, vs_baseline
 
 

@@ -149,23 +149,53 @@ def _make_runtime(runtime: str, net, args, props: Dict[str, str]):
     return ParallelWrapper(net, mesh=mesh)
 
 
-def cmd_train(args) -> int:
-    from deeplearning4j_tpu.nn.conf.neural_net import MultiLayerConfiguration
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    from deeplearning4j_tpu.utils.serializer import ModelSerializer
-
+def _net_from_document(doc: str):
+    """Build the right network from a config document, discriminating on
+    DOCUMENT SHAPE (not parse failure): a reference-exported Jackson
+    MultiLayer doc has a top-level "confs" list, a reference
+    ComputationGraph doc has "vertices" + "networkInputs"
+    (ComputationGraphConfiguration.java:59-70), our native graph format
+    self-identifies via its "format" tag, anything else is a native
+    MultiLayer doc. Non-JSON input parses as YAML (both reference
+    ``toYaml()`` flavors and our own block YAML)."""
     import json
+
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ComputationGraphConfiguration)
+    from deeplearning4j_tpu.nn.conf.neural_net import (
+        MultiLayerConfiguration)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    try:
+        parsed = json.loads(doc)
+    except json.JSONDecodeError:
+        from deeplearning4j_tpu.utils.yamlio import load
+
+        parsed = load(doc)
+    if not isinstance(parsed, dict):
+        raise SystemExit("model document is not a mapping")
+    from deeplearning4j_tpu.nn.conf.compat import (
+        _graph_from_reference_dict, _mln_from_reference_dict)
+
+    if "confs" in parsed:
+        return MultiLayerNetwork(_mln_from_reference_dict(parsed)).init()
+    if "vertices" in parsed and "networkInputs" in parsed:
+        return ComputationGraph(_graph_from_reference_dict(parsed)).init()
+    if str(parsed.get("format", "")).endswith(
+            "ComputationGraphConfiguration"):
+        return ComputationGraph(
+            ComputationGraphConfiguration.from_dict(parsed)).init()
+    return MultiLayerNetwork(MultiLayerConfiguration.from_dict(parsed)).init()
+
+
+def cmd_train(args) -> int:
+    from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
     props = load_properties(args.conf) if args.conf else {}
     with open(args.model) as f:
         doc = f.read()
-    # discriminate on document shape, not parse failure: a reference-
-    # exported Jackson document has a top-level "confs" list
-    if "confs" in json.loads(doc):
-        conf = MultiLayerConfiguration.from_reference_json(doc)
-    else:
-        conf = MultiLayerConfiguration.from_json(doc)
-    net = MultiLayerNetwork(conf).init()
+    net = _net_from_document(doc)
     runtime = args.runtime or props.get("runtime", "local")
     runner = _make_runtime(runtime, net, args, props)
     it = _build_iterator(args, props)

@@ -70,13 +70,11 @@ class HmmPosTagger:
             lambda: defaultdict(float))
         word_freq: Dict[str, float] = defaultdict(float)
         tag_set: Dict[str, int] = {}
-        rows: List[Tuple[List[str], List[str]]] = []
+        rows: List[List[str]] = []  # per-sentence tag sequences
         for sent in tagged_sentences:
             if not sent:  # blank lines in word/TAG files
                 continue
-            words = [w for w, _ in sent]
-            tags = [t for _, t in sent]
-            rows.append((words, tags))
+            rows.append([t for _, t in sent])
             for w, t in sent:
                 tag_set.setdefault(t, len(tag_set))
                 emit[t][w] += 1.0
@@ -89,7 +87,7 @@ class HmmPosTagger:
 
         trans = np.full((S, S), self.smoothing, np.float64)
         initial = np.full((S,), self.smoothing, np.float64)
-        for _, tags in rows:
+        for tags in rows:
             initial[self._tag_index[tags[0]]] += 1.0
             for a, b in zip(tags, tags[1:]):
                 trans[self._tag_index[a], self._tag_index[b]] += 1.0
@@ -146,13 +144,22 @@ class HmmPosTagger:
         return row
 
     def tag_tokens(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
+        from deeplearning4j_tpu.nlp.trees import pad_to_bucket
+
         if not self._fitted:
             raise RuntimeError("fit() the tagger before tagging")
         tokens = list(tokens)
         if not tokens:
             return []
-        emissions = np.stack([self._emission_row(w) for w in tokens])
-        path, _ = self._viterbi.decode(emissions)
+        n = len(tokens)
+        # pad T to a bucket so the jitted Viterbi scan compiles once per
+        # bucket, not once per sentence length; the masked decode makes
+        # the padding provably inert (identity backpointers)
+        T = pad_to_bucket(n)
+        emissions = np.zeros((T, len(self.tags)), np.float32)
+        for i, w in enumerate(tokens):
+            emissions[i] = self._emission_row(w)
+        path, _ = self._viterbi.decode(emissions, length=n)
         return [(w, self.tags[int(s)]) for w, s in zip(tokens, path)]
 
     def tag(self, sentence: str) -> List[Tuple[str, str]]:

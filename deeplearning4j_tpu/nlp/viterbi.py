@@ -38,22 +38,36 @@ class Viterbi:
             np.zeros((num_states,), np.float32) if initial is None
             else np.asarray(initial, np.float32))
         self._decode = jax.jit(self._decode_impl)
+        self._decode_masked = jax.jit(self._decode_impl)
         self._decode_batch = jax.jit(jax.vmap(self._decode_impl))
 
-    def _decode_impl(self, emissions: jnp.ndarray
+    def _decode_impl(self, emissions: jnp.ndarray,
+                     length: Optional[jnp.ndarray] = None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """emissions: [T, S] log-scores → (path [T] int32, log-score)."""
-        trans = self.transitions
+        """emissions: [T, S] log-scores → (path [T] int32, log-score).
 
-        def step(delta, emit_t):
-            # delta: [S] best score ending in each state
+        ``length`` (traced scalar ≤ T) masks a padded tail: steps at
+        index ≥ length carry delta through unchanged and record IDENTITY
+        backpointers, so the decoded prefix equals the unpadded decode
+        exactly — this is what lets callers pad T to a bucket and reuse
+        one compiled program across sentence lengths."""
+        trans = self.transitions
+        S = self.num_states
+
+        def step(delta, xs):
+            t, emit_t = xs
             scores = delta[:, None] + trans  # [from, to]
             best_prev = jnp.argmax(scores, axis=0)  # [to]
             delta_new = jnp.max(scores, axis=0) + emit_t
+            if length is not None:
+                live = t < length
+                delta_new = jnp.where(live, delta_new, delta)
+                best_prev = jnp.where(live, best_prev, jnp.arange(S))
             return delta_new, best_prev
 
         delta0 = self.initial + emissions[0]
-        delta_T, backptrs = lax.scan(step, delta0, emissions[1:])
+        ts = jnp.arange(1, emissions.shape[0])
+        delta_T, backptrs = lax.scan(step, delta0, (ts, emissions[1:]))
         last = jnp.argmax(delta_T)
         score = delta_T[last]
 
@@ -66,13 +80,21 @@ class Viterbi:
         return path.astype(jnp.int32), score
 
     # -- public API -----------------------------------------------------
-    def decode(self, emissions) -> Tuple[np.ndarray, float]:
-        """Decode one sequence of per-step label log-scores [T, S]."""
+    def decode(self, emissions, length: Optional[int] = None
+               ) -> Tuple[np.ndarray, float]:
+        """Decode one sequence of per-step label log-scores [T, S].
+        ``length`` treats rows ≥ length as padding (see _decode_impl);
+        the returned path/score cover only the first ``length`` steps."""
         e = jnp.asarray(np.asarray(emissions, np.float32))
         if e.ndim != 2 or e.shape[1] != self.num_states:
             raise ValueError(f"emissions must be [T, {self.num_states}]")
-        path, score = self._decode(e)
-        return np.asarray(path), float(score)
+        if length is None:
+            path, score = self._decode(e)
+            return np.asarray(path), float(score)
+        if not 1 <= length <= e.shape[0]:
+            raise ValueError(f"length {length} out of range 1..{e.shape[0]}")
+        path, score = self._decode_masked(e, jnp.int32(length))
+        return np.asarray(path)[:length], float(score)
 
     def decode_batch(self, emissions) -> Tuple[np.ndarray, np.ndarray]:
         """Decode a batch [B, T, S] → (paths [B, T], scores [B])."""

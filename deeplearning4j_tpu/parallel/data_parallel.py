@@ -139,7 +139,15 @@ class ParallelWrapper:
     def _shardable(self) -> bool:
         """Configs whose per-batch semantics the sharded one-step path
         preserves exactly — the same exclusion list as
-        MultiLayerNetwork.fit_steps (multilayer.py)."""
+        MultiLayerNetwork.fit_steps (multilayer.py). Only
+        MultiLayerNetwork speaks the sharded step protocol
+        (_train_step(lr_scale)/_sgd_step/_lr_scale_host); every other
+        model (e.g. ComputationGraph off the CLI) delegates to its own
+        fit path rather than crashing mid-mesh-setup."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if not isinstance(self.network, MultiLayerNetwork):
+            return False
         from deeplearning4j_tpu.nn.conf.enums import (
             BackpropType, LearningRatePolicy, OptimizationAlgorithm)
 

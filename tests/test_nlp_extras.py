@@ -64,6 +64,30 @@ class TestViterbi:
         assert abs(score - path_score(best)) < 1e-4
         np.testing.assert_array_equal(path, best)
 
+    def test_masked_decode_equals_unpadded(self):
+        """decode(length=n) over bucket-padded emissions must equal the
+        unpadded decode EXACTLY for every prefix length (the padding is
+        inert: identity backpointers, carried delta) — this is what lets
+        the POS tagger compile once per bucket instead of per sentence
+        length."""
+        rng = np.random.default_rng(3)
+        S, T_pad = 4, 16
+        trans = rng.normal(size=(S, S)).astype(np.float32)
+        init = rng.normal(size=(S,)).astype(np.float32)
+        v = Viterbi(S, transitions=trans, initial=init)
+        for n in (1, 2, 5, 9, 16):
+            e = rng.normal(size=(n, S)).astype(np.float32)
+            ref_path, ref_score = v.decode(e)
+            padded = np.zeros((T_pad, S), np.float32)
+            padded[:n] = e
+            # garbage in the padding must not leak into the result
+            padded[n:] = rng.normal(size=(T_pad - n, S)) * 10
+            path, score = v.decode(padded, length=n)
+            np.testing.assert_array_equal(path, ref_path)
+            assert abs(score - ref_score) < 1e-4
+        with pytest.raises(ValueError, match="out of range"):
+            v.decode(np.zeros((4, S), np.float32), length=5)
+
     def test_batch_decode(self):
         v = Viterbi(2)
         e = np.log(np.array([[[0.9, 0.1]] * 3, [[0.1, 0.9]] * 3], np.float32))

@@ -343,11 +343,17 @@ def _transformer_flops_per_token(lm, t):
         int(np.prod(p.shape)) for blk in lm.params["blocks"]
         for grp in blk.values() for p in grp.values())
     n_params_matmul += lm.d_model * lm.vocab_size  # tied unembedding
-    # attention term: avg keys/query is t/2 causal, ~window when banded
-    # (keeps windowed-config MFU honest — banding REMOVES model FLOPs)
-    avg_keys = (t // 2 if lm.attn_window is None
-                else min(t // 2, lm.attn_window))
-    return 6 * n_params_matmul + 12 * lm.num_layers * lm.d_model * avg_keys
+    # attention term: avg keys/query is t/2 causal; banded it is the
+    # exact causal-window average w·(t-(w-1)/2)/t — queries q < w-1 see
+    # only q+1 keys (keeps windowed-config MFU honest: banding REMOVES
+    # model FLOPs, and rounding the average UP would flatter the number)
+    if lm.attn_window is None or lm.attn_window >= t:
+        avg_keys = t / 2
+    else:
+        w = lm.attn_window
+        avg_keys = w * (t - (w - 1) / 2) / t
+    return int(6 * n_params_matmul
+               + 12 * lm.num_layers * lm.d_model * avg_keys)
 
 
 def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",

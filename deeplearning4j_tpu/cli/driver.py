@@ -229,7 +229,13 @@ def cmd_predict(args) -> int:
     net = ModelSerializer.restore(args.model)
     it = _build_iterator(args, props)
     ds = _full_dataset(it, args.input)
-    out = np.asarray(net.output(ds.features))
+    out = net.output(ds.features)
+    if isinstance(out, (list, tuple)):
+        # ComputationGraph.output returns one array per networkOutput;
+        # the CLI predicts on the first head (matches cmd_test's
+        # evaluate(output_index=0))
+        out = out[0]
+    out = np.asarray(out)
     lines: List[str] = []
     if args.probabilities:
         for row in out:

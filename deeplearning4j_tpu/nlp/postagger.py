@@ -114,8 +114,10 @@ class HmmPosTagger:
 
         log_trans = np.log(trans / trans.sum(axis=1, keepdims=True))
         log_init = np.log(initial / initial.sum())
-        self._viterbi = Viterbi(S, transitions=log_trans.astype(np.float32),
-                                initial=log_init.astype(np.float32))
+        self._log_trans = log_trans.astype(np.float32)
+        self._log_init = log_init.astype(np.float32)
+        self._viterbi = Viterbi(S, transitions=self._log_trans,
+                                initial=self._log_init)
         self._fitted = True
         return self
 
@@ -169,6 +171,49 @@ class HmmPosTagger:
 
         return self.tag_tokens(
             DefaultTokenizerFactory().create(sentence).get_tokens())
+
+    # -- persistence (SerializationUtils role for trained taggers) ------
+    def to_dict(self) -> dict:
+        if not self._fitted:
+            raise RuntimeError("fit() the tagger before serializing")
+        return {
+            "format": "deeplearning4j-tpu/HmmPosTagger",
+            "smoothing": self.smoothing,
+            "tags": self.tags,
+            "emission": self._emission,
+            "log_trans": self._log_trans.tolist(),
+            "log_init": self._log_init.tolist(),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "HmmPosTagger":
+        import numpy as _np
+
+        from deeplearning4j_tpu.nlp.viterbi import Viterbi
+
+        t = HmmPosTagger(smoothing=float(d.get("smoothing", 0.1)))
+        t.tags = list(d["tags"])
+        t._tag_index = {tag: i for i, tag in enumerate(t.tags)}
+        t._emission = [dict(e) for e in d["emission"]]
+        t._log_trans = _np.asarray(d["log_trans"], _np.float32)
+        t._log_init = _np.asarray(d["log_init"], _np.float32)
+        t._viterbi = Viterbi(len(t.tags), transitions=t._log_trans,
+                             initial=t._log_init)
+        t._fitted = True
+        return t
+
+    def save(self, path: str) -> None:
+        import json
+
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f)
+
+    @staticmethod
+    def load(path: str) -> "HmmPosTagger":
+        import json
+
+        with open(path, encoding="utf-8") as f:
+            return HmmPosTagger.from_dict(json.load(f))
 
     @staticmethod
     def from_treebank(trees) -> "HmmPosTagger":

@@ -214,3 +214,42 @@ class TreebankParser:
     def parse_many(self, sentences: Sequence[str],
                    tagger=None) -> List[Tree]:
         return [self.parse(s, tagger=tagger) for s in sentences]
+
+    # -- persistence (SerializationUtils role for trained parsers) ------
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j-tpu/TreebankParser",
+            "min_count": self.min_count,
+            "unk_smoothing": self.unk_smoothing,
+            "lexical": self.lexical,
+            # tuple keys → ["ls", "rs", [[parent, logp], ...]] rows
+            "binary": [[ls, rs, [[p, lp] for p, lp in rules]]
+                       for (ls, rs), rules in sorted(self.binary.items())],
+            "root_logp": self.root_logp,
+            "vocab": sorted(self._vocab),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TreebankParser":
+        p = TreebankParser(min_count=int(d.get("min_count", 1)),
+                           unk_smoothing=float(d.get("unk_smoothing", 1e-4)))
+        p.lexical = {s: dict(w) for s, w in d["lexical"].items()}
+        p.binary = {(ls, rs): [(par, float(lp)) for par, lp in rules]
+                    for ls, rs, rules in d["binary"]}
+        p.root_logp = dict(d["root_logp"])
+        p._vocab = set(d.get("vocab", ()))
+        p._fitted = True
+        return p
+
+    def save(self, path: str) -> None:
+        import json
+
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f)
+
+    @staticmethod
+    def load(path: str) -> "TreebankParser":
+        import json
+
+        with open(path, encoding="utf-8") as f:
+            return TreebankParser.from_dict(json.load(f))

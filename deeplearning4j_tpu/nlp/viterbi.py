@@ -38,7 +38,6 @@ class Viterbi:
             np.zeros((num_states,), np.float32) if initial is None
             else np.asarray(initial, np.float32))
         self._decode = jax.jit(self._decode_impl)
-        self._decode_masked = jax.jit(self._decode_impl)
         self._decode_batch = jax.jit(jax.vmap(self._decode_impl))
 
     def _decode_impl(self, emissions: jnp.ndarray,
@@ -93,7 +92,7 @@ class Viterbi:
             return np.asarray(path), float(score)
         if not 1 <= length <= e.shape[0]:
             raise ValueError(f"length {length} out of range 1..{e.shape[0]}")
-        path, score = self._decode_masked(e, jnp.int32(length))
+        path, score = self._decode(e, jnp.int32(length))
         return np.asarray(path)[:length], float(score)
 
     def decode_batch(self, emissions) -> Tuple[np.ndarray, np.ndarray]:

@@ -121,9 +121,15 @@ class ParallelWrapper:
                     "ParallelWrapper(fsdp=True) does not support "
                     "TBPTT/non-SGD/pretrain/SCORE-lr/iterations>1 "
                     "configs; use fsdp=False (replicated DP) for these")
-            logger.info("ParallelWrapper: non-shardable config (TBPTT/"
-                        "non-SGD/pretrain/SCORE-lr/iterations>1) — "
-                        "delegating to the network's own fit path")
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            reason = ("non-shardable config (TBPTT/non-SGD/pretrain/"
+                      "SCORE-lr/iterations>1)"
+                      if isinstance(net, MultiLayerNetwork)
+                      else f"{type(net).__name__} does not speak the "
+                           "MLN sharded-step protocol")
+            logger.info("ParallelWrapper: %s — delegating to the "
+                        "network's own fit path (single device)", reason)
             net.fit(data, num_epochs=num_epochs)
             return self
         if isinstance(data, DataSet):

@@ -302,6 +302,48 @@ class TestRuntimeDispatch:
         import os
         assert os.path.exists(model_out)
 
+    def test_graph_model_predict(self, tmp_path, toy_csv, capsys):
+        """predict (argmax AND --probabilities) works on a saved
+        ComputationGraph model: list-of-heads output takes head 0."""
+        import json
+
+        doc = json.dumps({
+            "vertices": {
+                "d": {"LayerVertex": {"layerConf": {
+                    "layer": {"dense": {"nIn": 4, "nOut": 8,
+                                        "activationFunction": "tanh",
+                                        "learningRate": 0.5}},
+                    "seed": 7, "numIterations": 4}}},
+                "out": {"LayerVertex": {"layerConf": {
+                    "layer": {"output": {"nIn": 8, "nOut": 2,
+                                         "lossFunction": "MCXENT",
+                                         "learningRate": 0.5}},
+                    "seed": 7, "numIterations": 4}}},
+            },
+            "vertexInputs": {"d": ["in"], "out": ["d"]},
+            "networkInputs": ["in"], "networkOutputs": ["out"],
+        })
+        ref_conf = tmp_path / "g_pred.json"
+        ref_conf.write_text(doc)
+        model_out = str(tmp_path / "model_g_pred.zip")
+        rc = main(["train", "-input", toy_csv, "-model", str(ref_conf),
+                   "-output", model_out, "--batch-size", "16",
+                   "--num-classes", "2", "--epochs", "2"])
+        assert rc == 0
+        capsys.readouterr()
+        rc = main(["predict", "-input", toy_csv, "-model", model_out,
+                   "--batch-size", "16", "--num-classes", "2"])
+        assert rc == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 80  # one argmax per example, not one total
+        assert set(lines) <= {"0", "1"}
+        rc = main(["predict", "-input", toy_csv, "-model", model_out,
+                   "--batch-size", "16", "--num-classes", "2",
+                   "--probabilities"])
+        assert rc == 0
+        probs = capsys.readouterr().out.strip().splitlines()
+        assert len(probs) == 80 and len(probs[0].split()) == 2
+
     def test_graph_model_mesh_runtime_delegates(self, tmp_path, toy_csv):
         """-runtime mesh with a ComputationGraph doc must not crash in
         ParallelWrapper (which speaks the MLN sharded-step protocol):

@@ -197,6 +197,32 @@ class TestTree:
         leaf_tags = [leaf.tag for leaf in tree2.leaves()]
         assert leaf_tags == ["DT", "NN", "VBD"]
 
+    def test_parser_and_tagger_persist(self, tmp_path):
+        """Trained parser + tagger round-trip through JSON files and
+        produce identical outputs (SerializationUtils role)."""
+        from deeplearning4j_tpu.nlp.postagger import HmmPosTagger
+        from deeplearning4j_tpu.nlp.treeparser import TreebankParser
+        from deeplearning4j_tpu.nlp.trees import Tree
+
+        bank = [Tree.parse("(S (NP (DT the) (NN cat)) (VP (VBD sat)))"),
+                Tree.parse("(S (NP (DT a) (NN dog)) (VP (VBD ran)))")] * 2
+        parser = TreebankParser().fit(bank)
+        tagger = HmmPosTagger.from_treebank(bank)
+        pp = str(tmp_path / "parser.json")
+        tp = str(tmp_path / "tagger.json")
+        parser.save(pp)
+        tagger.save(tp)
+        parser2 = TreebankParser.load(pp)
+        tagger2 = HmmPosTagger.load(tp)
+        toks = ["the", "wombat", "ran"]
+        assert tagger2.tag_tokens(toks) == tagger.tag_tokens(toks)
+        t1 = parser.parse_tokens(toks, tagger=tagger)
+        t2 = parser2.parse_tokens(toks, tagger=tagger2)
+        assert [l.tag for l in t1.leaves()] == [l.tag for l in t2.leaves()]
+        assert t1.tag == t2.tag
+        with pytest.raises(RuntimeError):
+            HmmPosTagger().to_dict()
+
     def test_pad_to_bucket(self):
         assert pad_to_bucket(3) == 8
         assert pad_to_bucket(9) == 16

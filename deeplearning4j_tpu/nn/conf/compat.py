@@ -36,7 +36,7 @@ model definition exported from the reference loads unchanged
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.enums import (
@@ -251,6 +251,15 @@ def _convert_global_conf(first: dict, layers) -> GlobalConf:
         if layer.learning_rate is not None:
             global_conf.learning_rate = float(layer.learning_rate)
             break
+    # per-layer learningRateSchedule (Layer.java:72; the Builder clones
+    # one schedule onto every layer) → the native global schedule
+    sched = (first.get("layer") or {})
+    if sched:
+        (_, layer_fields), = sched.items()
+        ref_sched = (layer_fields or {}).get("learningRateSchedule")
+        if ref_sched:
+            global_conf.lr_schedule = {int(k): float(v)
+                                       for k, v in ref_sched.items()}
     return global_conf
 
 
@@ -441,6 +450,224 @@ def graph_from_reference_yaml(document: str):
     if not isinstance(d, dict):
         raise ValueError("reference YAML document is not a mapping")
     return _graph_from_reference_dict(d)
+
+
+# ---------------------------------------------------------------------------
+# EXPORT: native configuration → reference Jackson format. The exact
+# inverse of the loaders above — enum .value spellings ARE the Java names,
+# so a to_reference_json document loads in the reference (and round-trips
+# through from_reference_json, which the fuzz test exercises).
+# ---------------------------------------------------------------------------
+
+_TAG_BY_CLASS = {cls: tag for tag, cls in _LAYER_TYPES.items()}
+_REF_KEY_BY_FIELD = {v: k for k, v in _FIELD_MAP.items()}
+
+
+def _export_distribution(d: Optional[dict]) -> Optional[dict]:
+    if not d:
+        return None
+    d = dict(d)
+    kind = d.pop("type")
+    return {kind: d}
+
+
+def _field_default(f) -> Any:
+    import dataclasses as _dc
+
+    if f.default is not _dc.MISSING:
+        return f.default
+    if f.default_factory is not _dc.MISSING:  # type: ignore[misc]
+        return f.default_factory()  # type: ignore[misc]
+    return None
+
+
+def _export_layer(layer: "L.LayerConf") -> dict:
+    tag = _TAG_BY_CLASS.get(type(layer))
+    if tag is None:
+        raise ValueError(
+            f"{type(layer).__name__} has no reference Jackson tag "
+            f"(exportable: {sorted(c.__name__ for c in _TAG_BY_CLASS)})")
+    import dataclasses as _dc
+    import enum as _enum
+
+    fields: Dict[str, Any] = {}
+    for f in _dc.fields(layer):
+        v = getattr(layer, f.name)
+        if v is None:
+            continue
+        ref_key = _REF_KEY_BY_FIELD.get(f.name)
+        if ref_key is None:
+            # native-only field: silently dropping it would re-import as
+            # a DIFFERENT network (e.g. convolution_mode="same" reverts
+            # to "truncate" and changes output shapes) — raise unless it
+            # still holds its default, same contract as unexportable
+            # layer/vertex types
+            if v != _field_default(f):
+                raise ValueError(
+                    f"{type(layer).__name__}.{f.name}={v!r} has no "
+                    "reference counterpart — the reference format "
+                    "cannot express it")
+            continue
+        if f.name == "dist":
+            v = _export_distribution(v)
+        elif isinstance(v, _enum.Enum):
+            v = v.value
+        elif isinstance(v, tuple):
+            v = list(v)
+        fields[ref_key] = v
+    return {tag: fields}
+
+
+# preprocessor export table, adjacent to the import chain in
+# _convert_preprocessor: class name → (wrapper tag, field names to copy)
+_HWC = (("inputHeight", "height"), ("inputWidth", "width"),
+        ("numChannels", "channels"))
+_PRE_EXPORT: Dict[str, Tuple[str, tuple]] = {
+    "CnnToFeedForwardPreProcessor": ("cnnToFeedForward", _HWC),
+    "FeedForwardToCnnPreProcessor": ("feedForwardToCnn", _HWC),
+    "CnnToRnnPreProcessor": ("cnnToRnn", _HWC),
+    "RnnToCnnPreProcessor": ("rnnToCnn", _HWC),
+    "FeedForwardToRnnPreProcessor": ("feedForwardToRnn", ()),
+    "RnnToFeedForwardPreProcessor": ("rnnToFeedForward", ()),
+    "UnitVariancePreProcessor": ("unitVariance", ()),
+    "ZeroMeanAndUnitVariancePreProcessor": ("zeroMeanAndUnitVariance", ()),
+    "ZeroMeanPrePreProcessor": ("zeroMean", ()),
+    "BinomialSamplingPreProcessor": ("binomialSampling", ()),
+}
+
+
+def _export_preprocessor(p: InputPreProcessor) -> dict:
+    name = type(p).__name__
+    if name == "ReshapePreProcessor":
+        return {"reshape": {"shape": list(p.shape)}}
+    if name == "ComposableInputPreProcessor":
+        return {"composableInput": {"inputPreProcessors": [
+            _export_preprocessor(c) for c in p.preprocessors]}}
+    entry = _PRE_EXPORT.get(name)
+    if entry is None:
+        raise ValueError(f"{name} has no reference wrapper tag")
+    tag, field_pairs = entry
+    return {tag: {ref: getattr(p, attr)
+                  for ref, attr in field_pairs
+                  if getattr(p, attr, None) is not None}}
+
+
+def _export_conf_entry(layer, global_conf: GlobalConf) -> dict:
+    """One ``confs`` element: the reference clones trainer-level fields
+    onto every per-layer NeuralNetConfiguration."""
+    # global hyperparameters with NO serialized reference counterpart
+    # (lrScoreBasedDecay lives only in the reference Builder; the others
+    # are native-only): raise rather than silently train differently
+    for attr, default, what in (
+            ("lr_score_based_decay_rate", 0.0,
+             "score-based LR decay (reference Builder-only, never "
+             "serialized)"),
+            ("mini_batch_size_divisor", None, "native-only field"),
+            ("dtype_policy", "float32", "native-only mixed-precision "
+                                        "policy")):
+        v = getattr(global_conf, attr)
+        if v != default:
+            raise ValueError(
+                f"GlobalConf.{attr}={v!r} cannot be expressed in the "
+                f"reference format ({what})")
+    layer_doc = _export_layer(layer)
+    # the reference carries the learning rate (and its schedule) per layer
+    (tag, fields), = layer_doc.items()
+    if "learningRate" not in fields and global_conf.learning_rate:
+        fields["learningRate"] = global_conf.learning_rate
+    if global_conf.lr_schedule:
+        fields["learningRateSchedule"] = {
+            str(k): v for k, v in global_conf.lr_schedule.items()}
+    return {
+        "layer": layer_doc,
+        "seed": global_conf.seed,
+        "numIterations": global_conf.iterations,
+        "optimizationAlgo": global_conf.optimization_algo.value,
+        "learningRatePolicy": global_conf.lr_policy.value,
+        "lrPolicyDecayRate": global_conf.lr_policy_decay_rate,
+        "lrPolicySteps": global_conf.lr_policy_steps,
+        "lrPolicyPower": global_conf.lr_policy_power,
+        "maxNumLineSearchIterations":
+            global_conf.max_num_line_search_iterations,
+        "miniBatch": global_conf.minibatch,
+        "useDropConnect": global_conf.use_drop_connect,
+    }
+
+
+def to_reference_json(conf: MultiLayerConfiguration) -> str:
+    """Export a native MultiLayerConfiguration as a reference-format
+    ``MultiLayerConfiguration.toJson()`` document."""
+    doc = {
+        "backprop": conf.backprop,
+        "pretrain": conf.pretrain,
+        "backpropType": conf.backprop_type.value,
+        "tbpttFwdLength": conf.tbptt_fwd_length,
+        "tbpttBackLength": conf.tbptt_back_length,
+        "confs": [_export_conf_entry(l, conf.global_conf)
+                  for l in conf.layers],
+    }
+    if conf.input_preprocessors:
+        doc["inputPreProcessors"] = {
+            str(i): _export_preprocessor(p)
+            for i, p in conf.input_preprocessors.items()}
+    return json.dumps(doc, indent=2)
+
+
+def graph_to_reference_json(conf) -> str:
+    """Export a native ComputationGraphConfiguration as a reference-format
+    ``ComputationGraphConfiguration.toJson()`` document. Vertices with no
+    reference tag (Scale/Stack/Unstack) raise — the reference format
+    cannot express them."""
+    from deeplearning4j_tpu.nn.conf import graph as G
+
+    vertices: Dict[str, Any] = {}
+    for name, layer in conf.layers.items():
+        lv: Dict[str, Any] = {
+            "layerConf": _export_conf_entry(layer, conf.global_conf)}
+        if name in conf.preprocessors:
+            lv["preProcessor"] = _export_preprocessor(
+                conf.preprocessors[name])
+        vertices[name] = {"LayerVertex": lv}
+    for name, v in conf.vertices.items():
+        if isinstance(v, G.MergeVertex):
+            vertices[name] = {"MergeVertex": {}}
+        elif isinstance(v, G.ElementWiseVertex):
+            if v.op not in ("Add", "Subtract", "Product"):
+                raise ValueError(
+                    f"vertex {name!r}: ElementWiseVertex op {v.op!r} "
+                    "cannot be expressed in the reference format (its "
+                    "enum is Add/Subtract/Product — "
+                    "ElementWiseVertex.java:39)")
+            vertices[name] = {"ElementWiseVertex": {"op": v.op}}
+        elif isinstance(v, G.SubsetVertex):
+            vertices[name] = {"SubsetVertex": {"from": v.from_index,
+                                               "to": v.to_index}}
+        elif isinstance(v, G.LastTimeStepVertex):
+            vertices[name] = {"LastTimeStepVertex":
+                              {"maskArrayInputName": v.mask_input}}
+        elif isinstance(v, G.DuplicateToTimeSeriesVertex):
+            vertices[name] = {"DuplicateToTimeSeriesVertex":
+                              {"inputName": v.input_name}}
+        elif isinstance(v, G.PreprocessorVertex):
+            pre = (InputPreProcessor.from_dict(v.preprocessor)
+                   if v.preprocessor else None)
+            vertices[name] = {"PreprocessorVertex": {
+                "preProcessor": _export_preprocessor(pre) if pre else None}}
+        else:
+            raise ValueError(
+                f"vertex {name!r} ({type(v).__name__}) has no reference "
+                "Jackson tag — the reference format cannot express it")
+    return json.dumps({
+        "vertices": vertices,
+        "vertexInputs": conf.vertex_inputs,
+        "networkInputs": conf.inputs,
+        "networkOutputs": conf.outputs,
+        "backprop": conf.backprop,
+        "pretrain": conf.pretrain,
+        "backpropType": conf.backprop_type.value,
+        "tbpttFwdLength": conf.tbptt_fwd_length,
+        "tbpttBackLength": conf.tbptt_back_length,
+    }, indent=2)
 
 
 def _safe_enum(enum_cls, value, default):

@@ -269,6 +269,14 @@ class ComputationGraphConfiguration:
 
         return graph_from_reference_yaml(s)
 
+    def to_reference_json(self) -> str:
+        """EXPORT as a reference-format ``toJson()`` document — the
+        inverse of :meth:`from_reference_json` (vertices with no
+        reference tag raise)."""
+        from deeplearning4j_tpu.nn.conf.compat import graph_to_reference_json
+
+        return graph_to_reference_json(self)
+
     def to_yaml(self) -> str:
         """Block-style YAML (ComputationGraphConfiguration toYaml parity)."""
         from deeplearning4j_tpu.utils.yamlio import dump

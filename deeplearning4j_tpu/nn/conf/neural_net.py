@@ -190,6 +190,14 @@ class MultiLayerConfiguration:
 
         return from_reference_yaml(s)
 
+    def to_reference_json(self) -> str:
+        """EXPORT as a reference-format ``toJson()`` document — the
+        inverse of :meth:`from_reference_json`, so configs interchange
+        with reference tooling in both directions."""
+        from deeplearning4j_tpu.nn.conf.compat import to_reference_json
+
+        return to_reference_json(self)
+
     @staticmethod
     def from_yaml(s: str) -> "MultiLayerConfiguration":
         """Parse to_yaml output (also accepts plain JSON, which is valid

@@ -596,6 +596,207 @@ class TestReferenceYamlLoader:
         assert np.isfinite(net.score_value)
 
 
+class TestReferenceExport:
+    """to_reference_json — the EXPORT half of the ecosystem contract.
+    Semantic round-trip: a config exported to the reference format and
+    re-imported must build a network with IDENTICAL outputs (same seed →
+    same init), which is stronger than structural equality (the formats
+    normalize learning-rate placement differently)."""
+
+    def _assert_semantic_roundtrip(self, conf, x):
+        back = MultiLayerConfiguration.from_reference_json(
+            conf.to_reference_json())
+        n1 = MultiLayerNetwork(conf).init()
+        n2 = MultiLayerNetwork(back).init()
+        o1 = np.asarray(n1.output(x))
+        o2 = np.asarray(n2.output(x))
+        np.testing.assert_allclose(o1, o2, rtol=1e-6, atol=1e-7)
+        # and one training step keeps them in lockstep (optimizer
+        # hyperparams survived the trip)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        y = np.eye(o1.shape[-1], dtype=np.float32)[
+            np.zeros(x.shape[0], np.int64)]
+        n1.fit(DataSet(x, y))
+        n2.fit(DataSet(x, y))
+        np.testing.assert_allclose(np.asarray(n1.output(x)),
+                                   np.asarray(n2.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mlp_round_trip(self):
+        conf = (
+            NeuralNetConfiguration.Builder().seed(11).learning_rate(0.05)
+            .updater(Updater.ADAM).list()
+            .layer(0, L.DenseLayer(n_in=6, n_out=8, activation="relu",
+                                   l2=1e-4, dropout=0.0))
+            .layer(1, L.OutputLayer(n_in=8, n_out=3,
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        x = np.random.default_rng(0).random((4, 6), np.float32)
+        self._assert_semantic_roundtrip(conf, x)
+
+    def test_conv_with_preprocessor_round_trip(self):
+        conf = (
+            NeuralNetConfiguration.Builder().seed(3).learning_rate(0.02)
+            .updater(Updater.RMSPROP).list()
+            .layer(0, L.ConvolutionLayer(n_in=1, n_out=4,
+                                         kernel_size=(3, 3),
+                                         stride=(1, 1), padding=(0, 0),
+                                         activation="relu",
+                                         weight_init=WeightInit.XAVIER))
+            .layer(1, L.DenseLayer(n_in=4 * 6 * 6, n_out=10,
+                                   activation="tanh"))
+            .layer(2, L.OutputLayer(n_in=10, n_out=2,
+                                    loss_function=LossFunction.MCXENT))
+            .input_pre_processor(1, CnnToFeedForwardPreProcessor(
+                height=6, width=6, channels=4))
+            .build()
+        )
+        doc = json.loads(conf.to_reference_json())
+        assert "cnnToFeedForward" in doc["inputPreProcessors"]["1"]
+        assert doc["confs"][0]["layer"]["convolution"]["kernelSize"] == [3, 3]
+        x = np.random.default_rng(1).random((2, 8, 8, 1), np.float32)
+        self._assert_semantic_roundtrip(conf, x)
+
+    def test_fuzz_random_dense_stacks(self):
+        """Randomized configs: export → import → identical outputs."""
+        rng = np.random.default_rng(7)
+        acts = ["relu", "tanh", "sigmoid", "leakyrelu"]
+        upds = [Updater.SGD, Updater.ADAM, Updater.RMSPROP,
+                Updater.ADAGRAD, Updater.NESTEROVS]
+        for trial in range(8):
+            depth = int(rng.integers(1, 4))
+            widths = [int(rng.integers(3, 9)) for _ in range(depth + 1)]
+            b = (NeuralNetConfiguration.Builder()
+                 .seed(int(rng.integers(0, 2 ** 31 - 1)))
+                 .learning_rate(float(rng.choice([0.5, 0.05, 0.01])))
+                 .updater(upds[trial % len(upds)])
+                 .list())
+            n_in = 5
+            for i, w in enumerate(widths[:-1]):
+                b.layer(i, L.DenseLayer(
+                    n_in=n_in, n_out=w,
+                    activation=str(rng.choice(acts)),
+                    l1=float(rng.choice([0.0, 1e-5])),
+                    l2=float(rng.choice([0.0, 1e-4]))))
+                n_in = w
+            b.layer(depth, L.OutputLayer(
+                n_in=n_in, n_out=widths[-1],
+                loss_function=LossFunction.MCXENT))
+            conf = b.build()
+            x = rng.random((3, 5), np.float32)
+            self._assert_semantic_roundtrip(conf, x)
+
+    def test_graph_export_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        g = (
+            NeuralNetConfiguration.Builder().seed(5).learning_rate(0.05)
+            .updater(Updater.ADAM)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", L.DenseLayer(n_in=4, n_out=6,
+                                          activation="relu"), "in")
+            .add_layer("d2", L.DenseLayer(n_in=4, n_out=6,
+                                          activation="tanh"), "in")
+            .add_vertex("merge", __import__(
+                "deeplearning4j_tpu.nn.conf.graph",
+                fromlist=["MergeVertex"]).MergeVertex(), "d1", "d2")
+            .add_layer("out", L.OutputLayer(
+                n_in=12, n_out=2,
+                loss_function=LossFunction.MCXENT), "merge")
+            .set_outputs("out")
+        )
+        conf = g.build()
+        back = ComputationGraphConfiguration.from_reference_json(
+            conf.to_reference_json())
+        assert back.inputs == conf.inputs
+        assert back.outputs == conf.outputs
+        assert set(back.layers) == set(conf.layers)
+        assert back.vertex_inputs == conf.vertex_inputs
+        x = np.random.default_rng(2).random((3, 4), np.float32)
+        o1 = ComputationGraph(conf).init().output(x)
+        o2 = ComputationGraph(back).init().output(x)
+        np.testing.assert_allclose(np.asarray(o1[0]), np.asarray(o2[0]),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_lr_schedule_round_trips(self):
+        """learningRateSchedule is a serialized per-layer reference field
+        (Layer.java:72): it must survive export → import into the native
+        global schedule, not silently vanish."""
+        conf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+            .learning_rate_schedule({5: 0.01, 20: 0.001})
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=3, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=3, n_out=2,
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        doc = json.loads(conf.to_reference_json())
+        assert doc["confs"][0]["layer"]["dense"][
+            "learningRateSchedule"] == {"5": 0.01, "20": 0.001}
+        back = MultiLayerConfiguration.from_reference_json(
+            conf.to_reference_json())
+        assert back.global_conf.lr_schedule == {5: 0.01, 20: 0.001}
+
+    def test_inexpressible_fields_raise(self):
+        """Native-only semantics-bearing settings must fail fast at
+        export, not silently re-import as a different network."""
+        base = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.01))
+        conv_conf = (base.list()
+                     .layer(0, L.ConvolutionLayer(
+                         n_in=1, n_out=2, kernel_size=(3, 3),
+                         stride=(1, 1), convolution_mode="same"))
+                     .layer(1, L.OutputLayer(
+                         n_in=8, n_out=2,
+                         loss_function=LossFunction.MCXENT))
+                     .build())
+        with pytest.raises(ValueError, match="convolution_mode"):
+            conv_conf.to_reference_json()
+        bf16 = (NeuralNetConfiguration.Builder().seed(0)
+                .learning_rate(0.01).dtype_policy("bf16").list()
+                .layer(0, L.OutputLayer(n_in=4, n_out=2,
+                                        loss_function=LossFunction.MCXENT))
+                .build())
+        with pytest.raises(ValueError, match="dtype_policy"):
+            bf16.to_reference_json()
+
+    def test_elementwise_average_raises(self):
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+
+        g = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_vertex("avg", ElementWiseVertex(op="Average"), "a", "b")
+            .add_layer("out", L.OutputLayer(
+                n_in=4, n_out=2, loss_function=LossFunction.MCXENT), "avg")
+            .set_outputs("out")
+        )
+        with pytest.raises(ValueError, match="Add/Subtract/Product"):
+            g.build().to_reference_json()
+
+    def test_inexpressible_vertex_raises(self):
+        from deeplearning4j_tpu.nn.conf.graph import ScaleVertex
+
+        g = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_vertex("s", ScaleVertex(scale=2.0), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=4, n_out=2, loss_function=LossFunction.MCXENT), "s")
+            .set_outputs("out")
+        )
+        with pytest.raises(ValueError, match="cannot express"):
+            g.build().to_reference_json()
+
+
 class TestReferenceJsonFullLayerMatrix:
     """Every Jackson wrapper tag in Layer.java:44-59 translates."""
 

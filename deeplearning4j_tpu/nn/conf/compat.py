@@ -251,15 +251,20 @@ def _convert_global_conf(first: dict, layers) -> GlobalConf:
         if layer.learning_rate is not None:
             global_conf.learning_rate = float(layer.learning_rate)
             break
-    # per-layer learningRateSchedule (Layer.java:72; the Builder clones
-    # one schedule onto every layer) → the native global schedule
+    # per-layer schedules (Layer.java:72,75; the Builder clones one
+    # schedule onto every layer) → the native global schedules
     sched = (first.get("layer") or {})
     if sched:
         (_, layer_fields), = sched.items()
-        ref_sched = (layer_fields or {}).get("learningRateSchedule")
+        layer_fields = layer_fields or {}
+        ref_sched = layer_fields.get("learningRateSchedule")
         if ref_sched:
             global_conf.lr_schedule = {int(k): float(v)
                                        for k, v in ref_sched.items()}
+        ref_mom = layer_fields.get("momentumSchedule")
+        if ref_mom:
+            global_conf.momentum_schedule = {int(k): float(v)
+                                             for k, v in ref_mom.items()}
     return global_conf
 
 
@@ -508,6 +513,16 @@ def _export_layer(layer: "L.LayerConf") -> dict:
                     "reference counterpart — the reference format "
                     "cannot express it")
             continue
+        if f.name in _ZERO_MEANS_UNSET and v == 0:
+            # the reference format writes 0.0 for UNSET updater
+            # hyperparameters (Jackson primitive defaults), which is why
+            # the importer's _ZERO_MEANS_UNSET drops zeros — an explicit
+            # 0.0 is therefore inexpressible and would re-import as the
+            # per-field default (e.g. momentum 0.9), silently
+            raise ValueError(
+                f"{type(layer).__name__}.{f.name}=0.0 cannot be "
+                "expressed in the reference format (0.0 means UNSET "
+                "there and re-imports as the default)")
         if f.name == "dist":
             v = _export_distribution(v)
         elif isinstance(v, _enum.Enum):
@@ -573,11 +588,19 @@ def _export_conf_entry(layer, global_conf: GlobalConf) -> dict:
     layer_doc = _export_layer(layer)
     # the reference carries the learning rate (and its schedule) per layer
     (tag, fields), = layer_doc.items()
-    if "learningRate" not in fields and global_conf.learning_rate:
+    if "learningRate" not in fields:
+        if not global_conf.learning_rate:
+            raise ValueError(
+                "GlobalConf.learning_rate=0.0 cannot be expressed in "
+                "the reference format (0.0 means UNSET there and "
+                "re-imports as the 0.1 default)")
         fields["learningRate"] = global_conf.learning_rate
     if global_conf.lr_schedule:
         fields["learningRateSchedule"] = {
             str(k): v for k, v in global_conf.lr_schedule.items()}
+    if global_conf.momentum_schedule:
+        fields["momentumSchedule"] = {
+            str(k): v for k, v in global_conf.momentum_schedule.items()}
     return {
         "layer": layer_doc,
         "seed": global_conf.seed,

@@ -59,6 +59,9 @@ class GlobalConf:
     lr_policy_steps: float = 1.0
     lr_policy_power: float = 1.0
     lr_schedule: Optional[Dict[int, float]] = None  # iteration → lr
+    # iteration → momentum, sticky from each key on (the reference's
+    # momentumAfter / Layer.momentumSchedule, BaseUpdater.java:75-80)
+    momentum_schedule: Optional[Dict[int, float]] = None
     lr_score_based_decay_rate: float = 0.0
     max_num_line_search_iterations: int = 5
     minibatch: bool = True  # divide loss/gradient by minibatch size
@@ -86,8 +89,8 @@ class GlobalConf:
                 continue
             if k in _ENUMS and isinstance(v, str):
                 v = _ENUMS[k](v)
-            if k == "lr_schedule" and v is not None:
-                v = {int(i): float(lr) for i, lr in v.items()}
+            if k in ("lr_schedule", "momentum_schedule") and v is not None:
+                v = {int(i): float(x) for i, x in v.items()}
             kwargs[k] = v
         return GlobalConf(**kwargs)
 
@@ -277,6 +280,14 @@ class NeuralNetConfiguration:
         def learning_rate_score_based_decay_rate(self, r: float):
             self._global.lr_score_based_decay_rate = float(r)
             self._global.lr_policy = LearningRatePolicy.SCORE
+            return self
+
+        def momentum_after(self, schedule: Dict[int, float]):
+            """Iteration → momentum, sticky from each key on (the
+            reference's ``momentumAfter``,
+            NeuralNetConfiguration.java:550)."""
+            self._global.momentum_schedule = {
+                int(k): float(v) for k, v in schedule.items()}
             return self
 
         def max_num_line_search_iterations(self, n: int):

@@ -110,7 +110,9 @@ class ComputationGraph:
                 self.params[name] = impl.init_params(sub)
                 self.net_state[name] = impl.init_state()
         self.updater_specs = {
-            n: UpdaterSpec.from_layer_conf(lc, gc.learning_rate)
+            n: UpdaterSpec.from_layer_conf(
+                lc, gc.learning_rate,
+                momentum_schedule=gc.momentum_schedule)
             for n, lc in self.conf.layers.items()
         }
         self.updater_state = {

@@ -87,7 +87,9 @@ class MultiLayerNetwork:
                 self.params[str(i)] = impl.init_params(sub)
                 self.net_state[str(i)] = impl.init_state()
         self.updater_specs = [
-            UpdaterSpec.from_layer_conf(lc, gc.learning_rate)
+            UpdaterSpec.from_layer_conf(
+                lc, gc.learning_rate,
+                momentum_schedule=gc.momentum_schedule)
             for lc in self.conf.layers
         ]
         self.updater_state = {

@@ -58,16 +58,27 @@ class UpdaterSpec:
     rms_decay: float = 0.95
     adam_mean_decay: float = 0.9
     adam_var_decay: float = 0.999
+    # ((iteration, momentum), ...) sorted — sticky from each key on
+    # (BaseUpdater.java:75-80 applyMomentumDecayPolicy); a tuple (not a
+    # dict) so the frozen spec stays hashable for jit static args
+    momentum_schedule: Optional[Tuple[Tuple[int, float], ...]] = None
     gradient_normalization: GradientNormalization = GradientNormalization.NONE
     gradient_normalization_threshold: float = 1.0
 
     @staticmethod
-    def from_layer_conf(conf: LayerConf, default_lr: float) -> "UpdaterSpec":
+    def from_layer_conf(conf: LayerConf, default_lr: float,
+                        momentum_schedule: Optional[Dict[int, float]] = None
+                        ) -> "UpdaterSpec":
         def pick(name):
             v = getattr(conf, name, None)
             return _DEFAULTS[name] if v is None else float(v)
 
+        sched = None
+        if momentum_schedule:
+            sched = tuple(sorted(
+                (int(k), float(v)) for k, v in momentum_schedule.items()))
         return UpdaterSpec(
+            momentum_schedule=sched,
             kind=conf.updater or Updater.SGD,
             learning_rate=(
                 float(conf.learning_rate)
@@ -157,6 +168,17 @@ def normalize_gradients(spec: UpdaterSpec, grads: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
+def _piecewise_constant(schedule: Dict[int, float], it, default):
+    """Sticky piecewise-constant lookup shared by the momentum schedule
+    and the SCHEDULE lr policy: value of the latest key ≤ ``it`` (traced
+    scalar), else ``default``."""
+    boundaries = jnp.asarray(sorted(schedule), jnp.float32)
+    values = jnp.asarray([schedule[k] for k in sorted(schedule)],
+                         jnp.float32)
+    idx = jnp.sum(boundaries <= it) - 1
+    return jnp.where(idx < 0, default, values[jnp.maximum(idx, 0)])
+
+
 def _apply_one(spec: UpdaterSpec, lr, g, s, t):
     """Returns (step_to_subtract, new_state) for one param array."""
     kind = spec.kind
@@ -174,6 +196,10 @@ def _apply_one(spec: UpdaterSpec, lr, g, s, t):
         # nd4j Nesterovs: v' = mu*v - lr*g; step = -(mu*v' - lr*g) ⇒
         # params += mu*v' - lr*g (we return the value to SUBTRACT)
         mu = spec.momentum
+        if spec.momentum_schedule:
+            # sticky switch: the latest key ≤ the 0-based iteration wins
+            mu = _piecewise_constant(
+                dict(spec.momentum_schedule), t - 1.0, default=mu)
         v_new = mu * s - lr * g
         step = -(mu * v_new - lr * g)
         return step, v_new
@@ -261,12 +287,9 @@ def lr_policy_scale(
         if not schedule:
             return jnp.asarray(1.0)
         # piecewise-constant absolute lr: factor = schedule_lr / base_lr
-        boundaries = jnp.asarray(sorted(schedule), jnp.float32)
-        values = jnp.asarray(
-            [schedule[k] for k in sorted(schedule)], jnp.float32
-        ) / jnp.maximum(base_lr, 1e-30)
-        idx = jnp.sum(boundaries <= it) - 1
-        return jnp.where(idx < 0, 1.0, values[jnp.maximum(idx, 0)])
+        factors = {k: v / max(base_lr, 1e-30)
+                   for k, v in schedule.items()}
+        return _piecewise_constant(factors, it, default=1.0)
     if policy == LearningRatePolicy.SCORE:
         # score-based decay is driven host-side (Solver watches the score and
         # shrinks lr); inside the step it is identity.

@@ -766,6 +766,28 @@ class TestReferenceExport:
         with pytest.raises(ValueError, match="dtype_policy"):
             bf16.to_reference_json()
 
+    def test_explicit_zero_hyperparams_raise(self):
+        """The reference format writes 0.0 for UNSET updater
+        hyperparameters (why the importer's _ZERO_MEANS_UNSET drops
+        zeros) — an explicit 0.0 would re-import as the default, so
+        export must refuse it."""
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .learning_rate(0.01).list()
+                .layer(0, L.DenseLayer(n_in=4, n_out=3, momentum=0.0,
+                                       updater=Updater.NESTEROVS))
+                .layer(1, L.OutputLayer(n_in=3, n_out=2,
+                                        loss_function=LossFunction.MCXENT))
+                .build())
+        with pytest.raises(ValueError, match="momentum=0.0"):
+            conf.to_reference_json()
+        frozen = (NeuralNetConfiguration.Builder().seed(0)
+                  .learning_rate(0.0).list()
+                  .layer(0, L.OutputLayer(n_in=4, n_out=2,
+                                          loss_function=LossFunction.MCXENT))
+                  .build())
+        with pytest.raises(ValueError, match="learning_rate=0.0"):
+            frozen.to_reference_json()
+
     def test_elementwise_average_raises(self):
         from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
 

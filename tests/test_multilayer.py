@@ -151,6 +151,84 @@ class TestSolvers:
         assert net.score(ds) < initial * 0.7, (algo, initial, net.score(ds))
 
 
+class TestMomentumSchedule:
+    """momentumAfter parity (BaseUpdater.java:75-80): momentum switches
+    STICKILY at each schedule iteration."""
+
+    def test_nesterovs_matches_hand_rolled_sticky_switch(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.updater import (
+            UpdaterSpec, apply_updater, init_updater_state)
+        from deeplearning4j_tpu.nn.conf.enums import Updater as U
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+
+        lc = DenseLayer(n_in=2, n_out=2, updater=U.NESTEROVS, momentum=0.9)
+        spec = UpdaterSpec.from_layer_conf(
+            lc, 0.1, momentum_schedule={2: 0.5, 4: 0.1})
+        g = {"W": jnp.ones((2, 2))}
+        state = init_updater_state(spec, g)
+        # hand-rolled nd4j Nesterovs with the sticky switch
+        v_ref, mus = np.zeros((2, 2)), []
+        steps_ref = []
+        for it in range(6):
+            mu = 0.9 if it < 2 else (0.5 if it < 4 else 0.1)
+            mus.append(mu)
+            v_new = mu * v_ref - 0.1 * np.ones((2, 2))
+            steps_ref.append(-(mu * v_new - 0.1 * np.ones((2, 2))))
+            v_ref = v_new
+        for it in range(6):
+            steps, state = apply_updater(
+                spec, g, state, jnp.asarray(1.0),
+                jnp.asarray(it + 1))  # 1-based step ⇒ 0-based iteration
+            np.testing.assert_allclose(np.asarray(steps["W"]),
+                                       steps_ref[it], rtol=1e-6)
+
+    def test_network_trains_with_schedule_and_serializes(self, tmp_path):
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+            .updater(Updater.NESTEROVS).momentum_after({3: 0.5})
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=6, activation="tanh",
+                                   momentum=0.9))
+            .layer(1, L.OutputLayer(n_in=6, n_out=2))
+            .build()
+        )
+        assert conf.global_conf.momentum_schedule == {3: 0.5}
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)])
+        for _ in range(6):
+            net.fit(ds)
+        assert np.isfinite(net.score_value)
+        # the schedule survives native serde + the model zip
+        from deeplearning4j_tpu.nn.conf.neural_net import (
+            MultiLayerConfiguration)
+
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back.global_conf.momentum_schedule == {3: 0.5}
+        ModelSerializer.write_model(net, str(tmp_path / "m.zip"))
+        restored = ModelSerializer.restore(str(tmp_path / "m.zip"))
+        assert restored.conf.global_conf.momentum_schedule == {3: 0.5}
+
+    def test_reference_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.neural_net import (
+            MultiLayerConfiguration)
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+            .updater(Updater.NESTEROVS).momentum_after({2: 0.25})
+            .list()
+            .layer(0, L.OutputLayer(n_in=4, n_out=2))
+            .build()
+        )
+        back = MultiLayerConfiguration.from_reference_json(
+            conf.to_reference_json())
+        assert back.global_conf.momentum_schedule == {2: 0.25}
+
+
 class TestDropoutAndRegularization:
     def test_l2_shrinks_weights(self):
         ds = toy_classification(n=128)

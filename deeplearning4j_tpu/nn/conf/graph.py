@@ -277,6 +277,15 @@ class ComputationGraphConfiguration:
 
         return graph_to_reference_json(self)
 
+    def to_reference_yaml(self) -> str:
+        """EXPORT as a reference-format YAML document (block style, the
+        shape ``from_reference_yaml`` and SnakeYAML both accept)."""
+        import json as _json
+
+        from deeplearning4j_tpu.utils.yamlio import dump
+
+        return "---\n" + dump(_json.loads(self.to_reference_json()))
+
     def to_yaml(self) -> str:
         """Block-style YAML (ComputationGraphConfiguration toYaml parity)."""
         from deeplearning4j_tpu.utils.yamlio import dump

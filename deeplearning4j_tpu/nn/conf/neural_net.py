@@ -201,6 +201,15 @@ class MultiLayerConfiguration:
 
         return to_reference_json(self)
 
+    def to_reference_yaml(self) -> str:
+        """EXPORT as a reference-format YAML document (block style, the
+        shape ``from_reference_yaml`` and SnakeYAML both accept)."""
+        import json as _json
+
+        from deeplearning4j_tpu.utils.yamlio import dump
+
+        return "---\n" + dump(_json.loads(self.to_reference_json()))
+
     @staticmethod
     def from_yaml(s: str) -> "MultiLayerConfiguration":
         """Parse to_yaml output (also accepts plain JSON, which is valid

@@ -766,6 +766,35 @@ class TestReferenceExport:
         with pytest.raises(ValueError, match="dtype_policy"):
             bf16.to_reference_json()
 
+    def test_reference_yaml_export_round_trips(self):
+        conf = (NeuralNetConfiguration.Builder().seed(2)
+                .learning_rate(0.05).updater(Updater.ADAM).list()
+                .layer(0, L.DenseLayer(n_in=4, n_out=3,
+                                       activation="tanh"))
+                .layer(1, L.OutputLayer(n_in=3, n_out=2,
+                                        loss_function=LossFunction.MCXENT))
+                .build())
+        back = MultiLayerConfiguration.from_reference_yaml(
+            conf.to_reference_yaml())
+        x = np.random.default_rng(4).random((3, 4), np.float32)
+        o1 = np.asarray(MultiLayerNetwork(conf).init().output(x))
+        o2 = np.asarray(MultiLayerNetwork(back).init().output(x))
+        np.testing.assert_allclose(o1, o2, rtol=1e-6, atol=1e-7)
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration)
+
+        g = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("out", L.OutputLayer(
+                 n_in=4, n_out=2, loss_function=LossFunction.MCXENT), "in")
+             .set_outputs("out"))
+        gc = g.build()
+        gback = ComputationGraphConfiguration.from_reference_yaml(
+            gc.to_reference_yaml())
+        assert set(gback.layers) == {"out"}
+        assert gback.inputs == ["in"]
+
     def test_explicit_zero_hyperparams_raise(self):
         """The reference format writes 0.0 for UNSET updater
         hyperparameters (why the importer's _ZERO_MEANS_UNSET drops

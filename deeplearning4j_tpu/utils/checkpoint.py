@@ -88,29 +88,65 @@ def restore_checkpoint(directory: str, target: Any = None,
         mgr.close()
 
 
-def save_network(directory: str, network, step: Optional[int] = None,
-                 keep: int = 3) -> None:
-    """Checkpoint a MultiLayerNetwork/ComputationGraph's training state."""
-    save_checkpoint(directory, {
+def _network_state(network) -> dict:
+    """The training-state pytree for any supported model class.
+
+    MultiLayerNetwork/ComputationGraph carry (params, updater_state,
+    net_state, iteration_count); TransformerLM carries (params, opt_state,
+    step_count). Sharded TransformerLM states (TP via shard_params, FSDP)
+    checkpoint as-is — Orbax writes each shard from where it lives, which
+    is exactly the multi-host path ModelSerializer's zip format refuses.
+    """
+    ensure = getattr(network, "_ensure_init", None)
+    if ensure is None:
+        raise TypeError(
+            f"cannot checkpoint {type(network).__name__}: expected a "
+            "MultiLayerNetwork/ComputationGraph/TransformerLM (for the "
+            "FSDP trainer, checkpoint the wrapped model)")
+    ensure()
+    if hasattr(network, "opt_state") and hasattr(network, "step_count"):
+        # TransformerLM (the FSDP wrapper also has opt_state but no
+        # step_count — it is not a model; checkpoint the model it wraps)
+        return {
+            "params": network.params,
+            "updater_state": network.opt_state,
+            "iteration": network.step_count,
+        }
+    if not hasattr(network, "updater_state"):
+        raise TypeError(
+            f"cannot checkpoint {type(network).__name__}: expected a "
+            "MultiLayerNetwork/ComputationGraph/TransformerLM (for the "
+            "FSDP trainer, checkpoint the wrapped model)")
+    return {
         "params": network.params,
         "updater_state": network.updater_state,
         "net_state": network.net_state,
         "iteration": network.iteration_count,
-    }, step if step is not None else network.iteration_count, keep=keep)
+    }
+
+
+def save_network(directory: str, network, step: Optional[int] = None,
+                 keep: int = 3) -> None:
+    """Checkpoint a MultiLayerNetwork/ComputationGraph/TransformerLM's
+    training state."""
+    state = _network_state(network)
+    save_checkpoint(directory, state,
+                    step if step is not None else int(state["iteration"]),
+                    keep=keep)
 
 
 def restore_network(directory: str, network,
                     step: Optional[int] = None):
     """Restore training state saved by ``save_network`` into ``network``."""
-    network._ensure_init()
-    state = restore_checkpoint(directory, target={
-        "params": network.params,
-        "updater_state": network.updater_state,
-        "net_state": network.net_state,
-        "iteration": 0,
-    }, step=step)
+    target = _network_state(network)
+    target["iteration"] = 0
+    state = restore_checkpoint(directory, target=target, step=step)
     network.params = state["params"]
-    network.updater_state = state["updater_state"]
-    network.net_state = state["net_state"]
-    network.iteration_count = int(state["iteration"])
+    if hasattr(network, "opt_state"):
+        network.opt_state = state["updater_state"]
+        network.step_count = int(state["iteration"])
+    else:
+        network.updater_state = state["updater_state"]
+        network.net_state = state["net_state"]
+        network.iteration_count = int(state["iteration"])
     return network

@@ -56,9 +56,11 @@ def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
         if getattr(tree, "is_fully_addressable", True) is False:
             raise ValueError(
                 f"cannot serialize leaf {prefix!r}: array is sharded "
-                "across hosts (not fully addressable). Gather with "
-                "jax.experimental.multihost_utils.process_allgather and "
-                "write from process 0.")
+                "across hosts (not fully addressable). Use "
+                "utils.checkpoint.save_network (Orbax writes each shard "
+                "from where it lives), or gather with jax.experimental."
+                "multihost_utils.process_allgather and write from "
+                "process 0.")
         out[prefix] = np.asarray(tree)
     return out
 

@@ -39,6 +39,57 @@ def _trained_net(seed=0, steps=3):
     return net
 
 
+class TestTransformerShardedCheckpoint:
+    """TransformerLM through save_network/restore_network, including a
+    TP-SHARDED state: Orbax writes each shard from where it lives and
+    restores onto the target's shardings — the multi-host path the zip
+    serializer's fully-addressable guard points at."""
+
+    def _lm(self, seed=0):
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        return TransformerLM(vocab_size=32, d_model=32, num_heads=4,
+                             num_layers=1, max_len=16, lr=5e-3,
+                             seed=seed).init()
+
+    def test_transformer_round_trip(self, tmp_path):
+        import jax.numpy as jnp
+
+        lm = self._lm()
+        tok = jnp.asarray(np.tile(np.arange(8), (4, 2)), jnp.int32)
+        step = lm.make_train_step(donate=False)
+        for _ in range(3):
+            lm.fit_batch(tok, train_step=step)
+        save_network(str(tmp_path), lm, step=3)
+        other = self._lm(seed=1)
+        restore_network(str(tmp_path), other)
+        np.testing.assert_array_equal(
+            np.asarray(other.params["embed"]),
+            np.asarray(lm.params["embed"]))
+        assert other.step_count == lm.step_count
+        # optimizer moments restored: next identical step stays in sync
+        s2 = other.make_train_step(donate=False)
+        l1 = lm.fit_batch(tok, train_step=step)
+        l2 = other.fit_batch(tok, train_step=s2)
+        assert l1 == pytest.approx(l2, rel=1e-5)
+
+    def test_tp_sharded_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.parallel import MeshSpec, build_mesh
+
+        lm = self._lm()
+        mesh = build_mesh(MeshSpec(data=4, model=2))
+        lm.shard_params(mesh)
+        save_network(str(tmp_path), lm, step=1)
+        other = self._lm(seed=2)
+        other.shard_params(mesh)
+        restore_network(str(tmp_path), other)
+        wq = other.params["blocks"][0]["attn"]["wq"]
+        # restored ONTO the target's TP sharding, not gathered/replicated
+        assert "model" in (wq.sharding.spec or ())
+        np.testing.assert_array_equal(
+            np.asarray(wq), np.asarray(lm.params["blocks"][0]["attn"]["wq"]))
+
+
 class TestCheckpointRoundTrip:
     def test_pytree_with_scalar_leaves(self, tmp_path):
         state = {

@@ -196,16 +196,41 @@ def cmd_train(args) -> int:
     with open(args.model) as f:
         doc = f.read()
     net = _net_from_document(doc)
+    ckpt_dir = args.checkpoint_dir or props.get("checkpoint.dir")
+    start_epoch = 0
+    if args.resume and not ckpt_dir:
+        raise SystemExit(
+            "--resume requires --checkpoint-dir (or the checkpoint.dir "
+            "property) — refusing to silently retrain from scratch")
+    if ckpt_dir and args.resume:
+        from deeplearning4j_tpu.utils.checkpoint import (
+            latest_step, restore_network)
+
+        step = latest_step(ckpt_dir)
+        if step is not None:
+            restore_network(ckpt_dir, net, step=step)
+            start_epoch = step
+            print(f"resumed from checkpoint epoch {step} in {ckpt_dir}")
+        else:
+            print(f"no checkpoint in {ckpt_dir}; training from scratch")
     runtime = args.runtime or props.get("runtime", "local")
     runner = _make_runtime(runtime, net, args, props)
     it = _build_iterator(args, props)
     epochs = (args.epochs if args.epochs is not None
               else int(props.get("epochs", "1")))
-    for _ in range(epochs):
+    for epoch in range(start_epoch, epochs):
         it.reset()
         runner.fit(it)
+        if ckpt_dir:
+            from deeplearning4j_tpu.utils.checkpoint import save_network
+
+            # epoch-keyed Orbax checkpoint: kill the process anywhere
+            # and --resume picks up after the last completed epoch
+            save_network(ckpt_dir, net, step=epoch + 1)
     ModelSerializer.write_model(net, args.output)
-    print(f"model trained ({epochs} epoch(s), runtime={runtime}) "
+    ran = max(0, epochs - start_epoch)
+    suffix = f" ({start_epoch} resumed)" if start_epoch else ""
+    print(f"model trained ({ran} epoch(s){suffix}, runtime={runtime}) "
           f"and saved to {args.output}")
     return 0
 
@@ -289,6 +314,12 @@ def build_parser() -> argparse.ArgumentParser:
                               "also the 'runtime' property")
     p_train.add_argument("--mesh-devices", type=int, default=None,
                          help="cap the mesh at N devices (default: all)")
+    p_train.add_argument("--checkpoint-dir", default=None,
+                         help="Orbax checkpoint dir: saves after every "
+                              "epoch (property: checkpoint.dir)")
+    p_train.add_argument("--resume", action="store_true",
+                         help="resume from the latest checkpoint in "
+                              "--checkpoint-dir")
     p_train.add_argument("--coordinator", default=None,
                          help="multihost coordinator host:port")
     p_train.add_argument("--num-processes", type=int, default=None)

@@ -26,13 +26,60 @@ def _manager(directory: str, keep: int = 3):
     )
 
 
+def _strip_empty(tree: Any) -> Any:
+    """Replace zero-size array leaves with None (Orbax refuses to
+    serialize empty arrays). The SGD/NONE updaters use ``zeros((0,))``
+    state placeholders, so network states routinely contain them;
+    ``restore_checkpoint(target=...)`` reinstates them from the target."""
+    return jax.tree_util.tree_map(
+        lambda x: None if getattr(x, "size", 1) == 0 else x, tree)
+
+
+def _has_nonempty_leaves(tree: Any) -> bool:
+    return any(getattr(leaf, "size", 1) != 0
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def _reinstate_empty(restored: Any, target: Any, path: str = "") -> Any:
+    """Paired walk: wherever ``target`` holds a zero-size array (stripped
+    to None at save time), keep the target's placeholder; everywhere else
+    take the restored value. A restored tree missing a subtree that
+    should carry DATA is a structure mismatch and raises (all-empty
+    subtrees are legitimately absent)."""
+    if isinstance(target, dict):
+        rd = restored if isinstance(restored, dict) else {}
+        out = {}
+        for k, v in target.items():
+            sub_path = f"{path}/{k}" if path else str(k)
+            if k not in rd:
+                if _has_nonempty_leaves(v):
+                    raise ValueError(
+                        f"restored checkpoint is missing entry "
+                        f"{sub_path!r} (incompatible target?)")
+                out[k] = v  # all-empty subtree: target placeholders
+                continue
+            out[k] = _reinstate_empty(rd[k], v, sub_path)
+        return out
+    if isinstance(target, (list, tuple)):
+        rl = restored if isinstance(restored, (list, tuple)) \
+            else [None] * len(target)
+        merged = [_reinstate_empty(r, t, f"{path}/[{i}]")
+                  for i, (r, t) in enumerate(zip(rl, target))]
+        if isinstance(target, tuple) and hasattr(target, "_fields"):
+            return type(target)(*merged)  # namedtuple protocol
+        return type(target)(merged)
+    if getattr(target, "size", 1) == 0:
+        return target
+    return restored
+
+
 def save_checkpoint(directory: str, state: Any, step: int,
                     keep: int = 3) -> None:
     """Write ``state`` (pytree of arrays/scalars) as step ``step``."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, keep)
-    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.save(step, args=ocp.args.StandardSave(_strip_empty(state)))
     mgr.wait_until_finished()
     mgr.close()
 
@@ -82,8 +129,10 @@ def restore_checkpoint(directory: str, target: Any = None,
             arr = np.asarray(x)
             return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
-        abstract = jax.tree_util.tree_map(_abstract, target)
-        return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        abstract = jax.tree_util.tree_map(_abstract, _strip_empty(target))
+        restored = mgr.restore(step,
+                               args=ocp.args.StandardRestore(abstract))
+        return _reinstate_empty(restored, target)
     finally:
         mgr.close()
 

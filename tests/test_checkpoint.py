@@ -110,6 +110,22 @@ class TestCheckpointRoundTrip:
         np.testing.assert_array_equal(np.asarray(out["params"]["W"]),
                                       np.asarray(state["params"]["W"]))
 
+    def test_zero_size_leaves_round_trip(self, tmp_path):
+        """SGD/NONE updater state holds zeros((0,)) placeholders, which
+        Orbax refuses to serialize — they are stripped at save and
+        reinstated from the target at restore."""
+        state = {
+            "params": {"W": jnp.ones((2, 2))},
+            "updater_state": {"W": jnp.zeros((0,), jnp.float32)},
+            "iteration": 3,
+        }
+        save_checkpoint(str(tmp_path), state, step=3)
+        out = restore_checkpoint(str(tmp_path), target=state)
+        np.testing.assert_array_equal(np.asarray(out["params"]["W"]),
+                                      np.ones((2, 2)))
+        assert out["updater_state"]["W"].shape == (0,)
+        assert int(out["iteration"]) == 3
+
     def test_network_save_restore(self, tmp_path):
         net = _trained_net()
         save_network(str(tmp_path), net)

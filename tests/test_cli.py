@@ -131,6 +131,62 @@ class TestTrainTestPredict:
         assert ev.accuracy() > 0.9
 
 
+class TestCheckpointResume:
+    def test_train_checkpoints_and_resumes(self, tmp_path, toy_csv,
+                                           conf_json, capsys):
+        """--checkpoint-dir saves per epoch; --resume continues after the
+        last completed epoch (kill-anywhere fault tolerance)."""
+        ck = str(tmp_path / "ckpt")
+        out1 = str(tmp_path / "m1.zip")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-output", out1, "--num-classes", "2",
+                   "--batch-size", "16", "--epochs", "2",
+                   "--checkpoint-dir", ck])
+        assert rc == 0
+        from deeplearning4j_tpu.utils.checkpoint import latest_step
+
+        assert latest_step(ck) == 2
+        capsys.readouterr()
+        # resume at 2 of 4: exactly two more epochs run
+        out2 = str(tmp_path / "m2.zip")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-output", out2, "--num-classes", "2",
+                   "--batch-size", "16", "--epochs", "4",
+                   "--checkpoint-dir", ck, "--resume"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "resumed from checkpoint epoch 2" in out
+        assert "2 epoch(s) (2 resumed)" in out
+        assert latest_step(ck) == 4
+        # resume when already done: zero epochs run, model still written
+        out3 = str(tmp_path / "m3.zip")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-output", out3, "--num-classes", "2",
+                   "--batch-size", "16", "--epochs", "4",
+                   "--checkpoint-dir", ck, "--resume"])
+        assert rc == 0
+        import os
+        assert os.path.exists(out3)
+
+    def test_resume_without_dir_rejected(self, tmp_path, toy_csv,
+                                         conf_json):
+        with pytest.raises(SystemExit, match="checkpoint-dir"):
+            main(["train", "-input", toy_csv, "-model", conf_json,
+                  "-output", str(tmp_path / "m.zip"),
+                  "--num-classes", "2", "--resume"])
+
+    def test_resume_without_checkpoint_trains_fresh(self, tmp_path,
+                                                    toy_csv, conf_json,
+                                                    capsys):
+        ck = str(tmp_path / "empty_ck")
+        rc = main(["train", "-input", toy_csv, "-model", conf_json,
+                   "-output", str(tmp_path / "m.zip"),
+                   "--num-classes", "2", "--batch-size", "16",
+                   "--epochs", "1", "--checkpoint-dir", ck, "--resume"])
+        assert rc == 0
+        assert "training from scratch" in capsys.readouterr().out
+
+
 class TestProperties:
     def test_load_properties(self, tmp_path):
         p = tmp_path / "x.properties"

@@ -213,11 +213,21 @@ def cmd_train(args) -> int:
             print(f"resumed from checkpoint epoch {step} in {ckpt_dir}")
         else:
             print(f"no checkpoint in {ckpt_dir}; training from scratch")
+    epochs_requested = (args.epochs if args.epochs is not None
+                        else int(props.get("epochs", "1")))
+    if start_epoch > epochs_requested:
+        # an iteration-keyed directory (e.g. CheckpointIterationListener's)
+        # would silently skip ALL training if treated as an epoch count
+        raise SystemExit(
+            f"checkpoint step {start_epoch} exceeds --epochs "
+            f"{epochs_requested}: this directory is not epoch-keyed "
+            "(cli train writes one checkpoint per epoch; iteration-keyed "
+            "dirs from CheckpointIterationListener resume via "
+            "utils.checkpoint.restore_network instead)")
     runtime = args.runtime or props.get("runtime", "local")
     runner = _make_runtime(runtime, net, args, props)
     it = _build_iterator(args, props)
-    epochs = (args.epochs if args.epochs is not None
-              else int(props.get("epochs", "1")))
+    epochs = epochs_requested
     for epoch in range(start_epoch, epochs):
         it.reset()
         runner.fit(it)

@@ -20,6 +20,7 @@ from deeplearning4j_tpu.optimize.function import (  # noqa: F401
     minimize,
 )
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CheckpointIterationListener,
     ComposableIterationListener,
     IterationListener,
     ParamAndGradientIterationListener,

@@ -73,6 +73,27 @@ def _reinstate_empty(restored: Any, target: Any, path: str = "") -> Any:
     return restored
 
 
+class NetworkCheckpointer:
+    """Persistent manager for PERIODIC in-training saves: one Orbax
+    CheckpointManager per directory, saves run asynchronously (training
+    overlaps the write; Orbax serializes overlapping saves), and
+    ``close()`` drains the queue. One-shot callers should keep using
+    :func:`save_network`, which waits and closes per call."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self._mgr = _manager(directory, keep)
+
+    def save(self, network, step: int) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(
+            _strip_empty(_network_state(network))))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
 def save_checkpoint(directory: str, state: Any, step: int,
                     keep: int = 3) -> None:
     """Write ``state`` (pytree of arrays/scalars) as step ``step``."""

@@ -110,6 +110,45 @@ class TestCheckpointRoundTrip:
         np.testing.assert_array_equal(np.asarray(out["params"]["W"]),
                                       np.asarray(state["params"]["W"]))
 
+    def test_checkpoint_iteration_listener(self, tmp_path):
+        """CheckpointIterationListener writes iteration-keyed Orbax
+        checkpoints mid-training that restore_network resumes from."""
+        from deeplearning4j_tpu.optimize import CheckpointIterationListener
+
+        net = _trained_net(steps=0)
+        net.set_listeners(CheckpointIterationListener(
+            str(tmp_path), frequency=2, keep=2))
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.default_rng(1).integers(0, 3, 8)]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        for _ in range(4):
+            net.fit(DataSet(x, y))
+        net.listeners[0].close()  # drain async saves
+        assert latest_step(str(tmp_path)) == 4
+        other = _trained_net(seed=5, steps=0)
+        restore_network(str(tmp_path), other)
+        np.testing.assert_allclose(other.get_flat_params(),
+                                   net.get_flat_params(), rtol=0, atol=0)
+        assert other.iteration_count == 4
+
+    def test_listener_stride_survives_fused_iteration_jumps(self,
+                                                            tmp_path):
+        """Fused drivers (fit_steps) jump iteration_count by K per
+        listener firing; the save stride is >= based, not exact-modulo,
+        so checkpoints never become K-times rarer than configured."""
+        from deeplearning4j_tpu.optimize import CheckpointIterationListener
+
+        net = _trained_net(steps=0)
+        lst = CheckpointIterationListener(str(tmp_path), frequency=10)
+        # iteration jumps of 7: exact-modulo would first fire at 70
+        for it in (7, 14, 21, 28):
+            lst.iteration_done(net, it)
+        lst.close()
+        # >= stride saves at 14 (Δ14) and 28 (Δ14), never waits for 70
+        assert latest_step(str(tmp_path)) == 28
+
     def test_zero_size_leaves_round_trip(self, tmp_path):
         """SGD/NONE updater state holds zeros((0,)) placeholders, which
         Orbax refuses to serialize — they are stripped at save and

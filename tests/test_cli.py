@@ -168,6 +168,27 @@ class TestCheckpointResume:
         import os
         assert os.path.exists(out3)
 
+    def test_resume_from_iteration_keyed_dir_rejected(self, tmp_path,
+                                                      toy_csv, conf_json):
+        """A checkpoint step beyond --epochs means the dir is not
+        epoch-keyed (e.g. written by CheckpointIterationListener):
+        refuse rather than silently run zero epochs."""
+        from deeplearning4j_tpu.utils.checkpoint import save_network
+        from deeplearning4j_tpu.nn.conf.neural_net import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(
+                open(conf_json).read())).init()
+        ck = str(tmp_path / "iter_ck")
+        save_network(ck, net, step=400)
+        with pytest.raises(SystemExit, match="not epoch-keyed"):
+            main(["train", "-input", toy_csv, "-model", conf_json,
+                  "-output", str(tmp_path / "m.zip"),
+                  "--num-classes", "2", "--epochs", "4",
+                  "--checkpoint-dir", ck, "--resume"])
+
     def test_resume_without_dir_rejected(self, tmp_path, toy_csv,
                                          conf_json):
         with pytest.raises(SystemExit, match="checkpoint-dir"):

@@ -1,0 +1,16 @@
+"""Version-compat shims for the installed JAX.
+
+``shard_map`` moved around across JAX releases: new versions export it at
+top level (``jax.shard_map``), older ones only under
+``jax.experimental.shard_map``. Import it from here so the parallel modules
+run on either layout.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5-ish exports shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]  # noqa: F401
+except ImportError:  # jax 0.4.x keeps it experimental
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["shard_map"]

@@ -16,20 +16,127 @@ without the real data. ``is_synthetic`` reports which one you got.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
+import shutil
 import struct
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import BaseDataSetIterator
+from deeplearning4j_tpu.resilience import (
+    FaultInjected,
+    RetryError,
+    RetryPolicy,
+    faults,
+)
+from deeplearning4j_tpu.utils.fileio import atomic_write_bytes
+
+logger = logging.getLogger(__name__)
 
 
 def data_dir() -> str:
     return os.environ.get(
         "DL4J_TPU_DATA_DIR",
         os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
+
+
+# ---------------------------------------------------------------------------
+# on-demand download (base/MnistFetcher.java role), retry-guarded
+# ---------------------------------------------------------------------------
+
+#: canonical MNIST idx files (the reference's MnistFetcher URLs, modulo host)
+MNIST_URLS = {
+    name: f"https://ossci-datasets.s3.amazonaws.com/mnist/{name}.gz"
+    for name in ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                 "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+}
+
+
+def downloads_allowed() -> bool:
+    """Zero-egress by default: fetchers only reach the network when
+    ``DL4J_TPU_ALLOW_DOWNLOAD=1`` (CI images and tests stay offline)."""
+    return os.environ.get("DL4J_TPU_ALLOW_DOWNLOAD", "") == "1"
+
+
+def default_download_retry_policy() -> RetryPolicy:
+    import http.client
+
+    # HTTPException covers connection-dropped-mid-body (IncompleteRead),
+    # which does NOT subclass OSError but is just as transient
+    return RetryPolicy(max_attempts=4, base_delay_s=0.5, max_delay_s=8.0,
+                       retryable=(OSError, http.client.HTTPException,
+                                  FaultInjected))
+
+
+def download_file(url: str, dest: str,
+                  policy: Optional[RetryPolicy] = None,
+                  opener: Optional[Callable] = None) -> str:
+    """Download ``url`` to ``dest`` atomically (tempfile + rename, so a
+    killed download never leaves a truncated file under the real name),
+    retrying transient network errors under the shared
+    :class:`RetryPolicy`. Fires the ``fetcher.download`` fault point once
+    per attempt. ``opener``: urlopen-compatible callable (tests substitute
+    an in-memory one)."""
+    policy = policy or default_download_retry_policy()
+
+    def attempt():
+        faults.fault_point("fetcher.download")
+        import urllib.request
+
+        opn = opener or urllib.request.urlopen
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+
+        def write(out):
+            with opn(url) as resp:
+                shutil.copyfileobj(resp, out)
+
+        atomic_write_bytes(dest, write)
+
+    policy.call(attempt)
+    return dest
+
+
+def _valid_idx_gz(path: str) -> bool:
+    """Cheap integrity check before a download enters the permanent
+    cache: a gzip'd idx file must decompress and carry an idx magic
+    (2051 images / 2049 labels). Catches mirror error pages served with
+    HTTP 200, which would otherwise poison every later (even offline)
+    run."""
+    try:
+        with gzip.open(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+        return magic in (2051, 2049)
+    except (OSError, struct.error, EOFError):
+        return False
+
+
+def _maybe_download_mnist(base: str, name: str) -> Optional[str]:
+    """Fetch one idx file when downloads are enabled; None (→ synthetic
+    fallback) when disabled, when retries were exhausted, or when the
+    downloaded content fails validation — a flaky/broken mirror degrades
+    to the surrogate instead of failing the pipeline."""
+    if not downloads_allowed() or name not in MNIST_URLS:
+        return None
+    dest = os.path.join(base, name + ".gz")
+    try:
+        download_file(MNIST_URLS[name], dest)
+    except RetryError as e:
+        logger.warning("download of %s failed after retries (%s); using "
+                       "synthetic surrogate", name, e)
+        return None
+    if not _valid_idx_gz(dest):
+        logger.warning("download of %s is not a valid idx.gz (mirror "
+                       "error page?); discarding and using synthetic "
+                       "surrogate", name)
+        try:
+            os.unlink(dest)  # never poison the cache
+        except FileNotFoundError:
+            pass
+        return None
+    return dest
 
 
 # ---------------------------------------------------------------------------
@@ -103,11 +210,20 @@ class MnistDataFetcher(BaseDataFetcher):
                  seed: int = 123):
         img_name, lbl_name = self.FILES[train]
         base = os.path.join(data_dir(), "mnist")
-        img_path = _first_existing(base, img_name)
-        synthetic = img_path is None
+        # each file resolves independently: local copy, else on-demand
+        # fetch (opt-in, retry-guarded) — a cached image file must not
+        # suppress downloading a missing label file
+        img_path = (_first_existing(base, img_name)
+                    or _maybe_download_mnist(base, img_name))
+        lbl_path = _first_existing(base, lbl_name)
+        if lbl_path is None and img_path is not None:
+            # short-circuit: once the image fetch failed, synthetic is
+            # already decided — don't burn the label fetch's retry budget
+            lbl_path = _maybe_download_mnist(base, lbl_name)
+        synthetic = img_path is None or lbl_path is None
         if not synthetic:
             x = _read_idx_images(img_path)
-            y = _read_idx_labels(_first_existing(base, lbl_name))
+            y = _read_idx_labels(lbl_path)
         else:
             n = num_examples or (60000 if train else 10000)
             n = min(n, 10000)  # keep the synthetic surrogate small

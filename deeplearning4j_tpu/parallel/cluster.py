@@ -22,6 +22,9 @@ ONE SPMD program and XLA collectives synchronize it — so what remains is:
 from __future__ import annotations
 
 import glob
+import hashlib
+import json
+import logging
 import os
 import threading
 import time
@@ -29,6 +32,11 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.parallel.statetracker import StateTracker
+from deeplearning4j_tpu.resilience import RetryError, RetryPolicy, faults
+from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
+from deeplearning4j_tpu.utils.fileio import atomic_write_text
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_EVICTION_TIMEOUT_S = 120.0  # MasterActor parity
 
@@ -45,32 +53,40 @@ class ClusterConfig:
 
 
 def initialize_distributed(config: ClusterConfig, retries: int = 3,
-                           retry_delay_s: float = 5.0) -> bool:
+                           retry_delay_s: float = 5.0,
+                           policy: Optional[RetryPolicy] = None) -> bool:
     """Join the multi-host JAX runtime; returns True when initialized.
 
-    Single-process configs are a no-op (False). Failures retry with delay —
-    the reference's equivalent is YARN re-requesting containers / Akka
-    cluster re-join.
+    Single-process configs are a no-op (False). Failures retry under the
+    shared :class:`RetryPolicy` (exponential backoff + jitter; pass
+    ``policy`` to control it — ``retries``/``retry_delay_s`` are the
+    legacy knobs and seed the default policy). The reference's equivalent
+    is YARN re-requesting containers / Akka cluster re-join.
     """
     if config.num_processes <= 1 or config.coordinator_address is None:
         return False
-    import jax
+    if policy is None:
+        policy = RetryPolicy(max_attempts=retries,
+                             base_delay_s=retry_delay_s,
+                             max_delay_s=4 * retry_delay_s)
 
-    last_err: Optional[Exception] = None
-    for _ in range(retries):
-        try:
-            jax.distributed.initialize(
-                coordinator_address=config.coordinator_address,
-                num_processes=config.num_processes,
-                process_id=config.process_id,
-            )
-            return True
-        except Exception as e:  # noqa: BLE001 — init raises RuntimeError/grpc
-            last_err = e
-            time.sleep(retry_delay_s)
-    raise RuntimeError(
-        f"jax.distributed.initialize failed after {retries} attempts"
-    ) from last_err
+    def init():
+        faults.fault_point("distributed.init")
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+
+    try:
+        policy.call(init)
+    except RetryError as e:
+        raise RuntimeError(
+            f"jax.distributed.initialize failed after {e.attempts} attempts"
+        ) from e.last
+    return True
 
 
 class HeartbeatMonitor:
@@ -94,15 +110,31 @@ class HeartbeatMonitor:
                    interval_s=config.heartbeat_interval_s,
                    eviction_timeout_s=config.eviction_timeout_s)
 
+    def _post(self) -> None:
+        # liveness must degrade, not crash: a transient tracker error
+        # (shared-fs hiccup, injected fault) skips one beat and keeps the
+        # thread alive — eviction only triggers after MANY missed beats
+        try:
+            self.tracker.heartbeat(self.worker_id)
+        except Exception:  # noqa: BLE001
+            logger.warning("heartbeat post failed for %s (will retry on "
+                           "next interval)", self.worker_id, exc_info=True)
+
     def start(self) -> "HeartbeatMonitor":
         if self._thread is not None:
-            return self
-        self._stop = threading.Event()  # support stop() → start() restart
-        self.tracker.heartbeat(self.worker_id)
+            if self._thread.is_alive():
+                return self
+            self._thread = None  # crashed/finished thread: allow restart
+        # a FRESH event captured by THIS thread's closure — stop() of a
+        # previous incarnation (possibly still draining its join timeout)
+        # can then never stop the new thread, and vice versa
+        stop = threading.Event()
+        self._stop = stop
+        self._post()
 
         def run():
-            while not self._stop.wait(self.interval_s):
-                self.tracker.heartbeat(self.worker_id)
+            while not stop.wait(self.interval_s):
+                self._post()
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name=f"heartbeat-{self.worker_id}")
@@ -110,10 +142,12 @@ class HeartbeatMonitor:
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self.interval_s + 1.0)
-            self._thread = None
+        thread, stop = self._thread, self._stop
+        if thread is None:
+            return  # idempotent: stop() after stop() is a no-op
+        self._thread = None
+        stop.set()
+        thread.join(timeout=self.interval_s + 1.0)
 
     def __enter__(self) -> "HeartbeatMonitor":
         return self.start()
@@ -132,16 +166,28 @@ class FaultTolerantTrainer:
     Wraps any network with ``fit(DataSet)`` + the ModelSerializer contract.
     Saves ``ckpt-<iteration>.zip`` every ``checkpoint_every`` iterations and
     retains the newest ``keep`` checkpoints. ``resume()`` restores the
-    newest checkpoint (params + updater state + iteration counter) so a
-    relaunched process continues where the dead one stopped — the TPU
+    newest VERIFIED checkpoint (params + updater state + iteration counter)
+    so a relaunched process continues where the dead one stopped — the TPU
     replacement for Hazelcast state replication + actor restart.
+
+    Integrity contract: every save publishes a ``.sha256`` manifest sidecar
+    (hash + size + iteration, written atomically AFTER the zip). ``resume``
+    walks checkpoints newest → oldest and restores the first one whose
+    bytes match its manifest and whose archive loads — a truncated or
+    corrupt newest checkpoint (crash mid-write, bit-rot on shared storage)
+    falls back to the next-older one instead of crashing or silently
+    loading garbage. A checkpoint without a sidecar (pre-manifest writer)
+    is *unverified*: it is still attempted, but any load error falls
+    through to older candidates.
     """
 
     def __init__(self, network, checkpoint_dir: str,
                  checkpoint_every: int = 10, keep: int = 3,
                  tracker: Optional[StateTracker] = None,
                  worker_id: str = "worker-0",
-                 heartbeat_interval_s: float = 5.0):
+                 heartbeat_interval_s: float = 5.0,
+                 step_deadline_s: Optional[float] = None,
+                 on_stall: Optional[Callable[[float], None]] = None):
         self.network = network
         self.dir = checkpoint_dir
         self.every = max(1, checkpoint_every)
@@ -149,11 +195,17 @@ class FaultTolerantTrainer:
         self.tracker = tracker
         self.worker_id = worker_id
         self.heartbeat_interval_s = heartbeat_interval_s
+        self.step_deadline_s = step_deadline_s
+        self.on_stall = on_stall
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # ------------------------------------------------------------------
     def _ckpt_path(self, iteration: int) -> str:
         return os.path.join(self.dir, f"ckpt-{iteration:012d}.zip")
+
+    @staticmethod
+    def _manifest_path(ckpt_path: str) -> str:
+        return ckpt_path + ".sha256"
 
     def checkpoints(self) -> List[str]:
         return sorted(glob.glob(os.path.join(self.dir, "ckpt-*.zip")))
@@ -162,47 +214,136 @@ class FaultTolerantTrainer:
         cks = self.checkpoints()
         return cks[-1] if cks else None
 
+    # -- integrity -----------------------------------------------------
+    @staticmethod
+    def _sha256(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def _write_manifest(self, path: str) -> None:
+        manifest = {
+            "sha256": self._sha256(path),
+            "size": os.path.getsize(path),
+            "iteration": self.network.iteration_count,
+            "format": "dl4j-tpu-ckpt-manifest-v1",
+        }
+        atomic_write_text(self._manifest_path(path), json.dumps(manifest))
+
+    def verify_checkpoint(self, path: str) -> str:
+        """``"ok"`` (manifest matches), ``"unverified"`` (no manifest —
+        legacy writer), or ``"corrupt"`` (size/hash mismatch, i.e. a
+        partial write or bit-rot)."""
+        try:
+            with open(self._manifest_path(path)) as f:
+                manifest = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return "unverified"
+        try:
+            if os.path.getsize(path) != manifest.get("size"):
+                return "corrupt"
+            if self._sha256(path) != manifest.get("sha256"):
+                return "corrupt"
+        except OSError:
+            return "corrupt"
+        return "ok"
+
+    # -- save / resume -------------------------------------------------
     def save(self) -> str:
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
+        faults.fault_point("checkpoint.save")
         path = self._ckpt_path(self.network.iteration_count)
         tmp = path + ".tmp"
         ModelSerializer.write_model(self.network, tmp, save_updater=True)
         os.replace(tmp, path)
+        self._write_manifest(path)
         for old in self.checkpoints()[:-self.keep]:
             os.unlink(old)
+            try:
+                os.unlink(self._manifest_path(old))
+            except FileNotFoundError:
+                pass  # legacy checkpoint without a sidecar
         if self.tracker is not None:
             self.tracker.put_meta("latest_checkpoint", path)
         return path
 
+    def _resume_candidates(self) -> List[str]:
+        """Newest → oldest, with the tracker's replicated pointer appended
+        as a last resort (it may point outside self.dir after elastic
+        restart onto a different host)."""
+        cands = list(reversed(self.checkpoints()))
+        if self.tracker is not None:
+            meta = self.tracker.get_meta("latest_checkpoint")
+            if meta and meta not in cands:
+                cands.append(meta)
+        return cands
+
     def resume(self) -> bool:
-        """Restore the newest checkpoint into the wrapped network.
-        Returns True when a checkpoint was found."""
+        """Restore the newest checkpoint that passes integrity
+        verification AND loads cleanly; older checkpoints are fallbacks.
+        Returns True when one was restored, False when none exists (a
+        corrupt-only directory raises: silently starting from scratch
+        when state was expected is the one thing recovery must not do).
+        """
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
-        path = self.latest_checkpoint()
-        if path is None and self.tracker is not None:
-            path = self.tracker.get_meta("latest_checkpoint")
-        if path is None or not os.path.exists(path):
-            return False
-        restored = ModelSerializer.restore(path, load_updater=True)
-        net = self.network
-        net.params = restored.params
-        net.updater_state = restored.updater_state
-        net.net_state = restored.net_state
-        net.iteration_count = restored.iteration_count
-        return True
+        candidates = self._resume_candidates()
+        saw_corrupt = []
+        for path in candidates:
+            faults.fault_point("checkpoint.restore")
+            if not os.path.exists(path):
+                continue
+            verdict = self.verify_checkpoint(path)
+            if verdict == "corrupt":
+                logger.warning(
+                    "checkpoint %s failed integrity verification; falling "
+                    "back to an older checkpoint", path)
+                saw_corrupt.append(path)
+                continue
+            try:
+                restored = ModelSerializer.restore(path, load_updater=True)
+            except Exception as e:  # noqa: BLE001 — any load error ⇒ next
+                logger.warning(
+                    "checkpoint %s (%s) failed to load (%s); falling back "
+                    "to an older checkpoint", path, verdict, e)
+                saw_corrupt.append(path)
+                continue
+            net = self.network
+            net.params = restored.params
+            net.updater_state = restored.updater_state
+            net.net_state = restored.net_state
+            net.iteration_count = restored.iteration_count
+            if saw_corrupt:
+                logger.warning("resumed from fallback %s (skipped %d bad "
+                               "checkpoint(s))", path, len(saw_corrupt))
+            return True
+        if saw_corrupt:
+            raise RuntimeError(
+                f"all {len(saw_corrupt)} checkpoint(s) under {self.dir} "
+                f"are corrupt or unloadable; refusing to silently restart "
+                f"from scratch (newest: {saw_corrupt[0]})")
+        return False
 
     # ------------------------------------------------------------------
     def fit(self, data, num_epochs: int = 1,
             on_iteration: Optional[Callable[[int], None]] = None):
-        """Epoch loop with periodic checkpointing + heartbeats."""
+        """Epoch loop with periodic checkpointing + heartbeats. With
+        ``step_deadline_s`` set, a :class:`StepWatchdog` flags steps that
+        hang past the deadline (``on_stall`` picks the policy: log /
+        evict / abort — default logs)."""
         net = self.network
         monitor = None
+        watchdog = None
         if self.tracker is not None:
             monitor = HeartbeatMonitor(
                 self.tracker, self.worker_id,
                 interval_s=self.heartbeat_interval_s).start()
+        if self.step_deadline_s is not None:
+            watchdog = StepWatchdog(self.step_deadline_s,
+                                    on_stall=self.on_stall).start()
         try:
             for _ in range(num_epochs):
                 if hasattr(data, "reset"):
@@ -210,12 +351,16 @@ class FaultTolerantTrainer:
                 batches = [data] if not hasattr(data, "__iter__") else data
                 for ds in batches:
                     net.fit(ds)
+                    if watchdog is not None:
+                        watchdog.beat()
                     if net.iteration_count % self.every == 0:
                         self.save()
                     if on_iteration is not None:
                         on_iteration(net.iteration_count)
             self.save()
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             if monitor is not None:
                 monitor.stop()
         return self

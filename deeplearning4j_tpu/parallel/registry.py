@@ -20,6 +20,13 @@ import re
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from deeplearning4j_tpu.resilience import (
+    FaultInjected,
+    RetryError,
+    RetryPolicy,
+    faults,
+    no_jitter,
+)
 from deeplearning4j_tpu.utils.fileio import atomic_write_text
 
 _NAME_RE = re.compile(r"\A[A-Za-z0-9._-]+\Z")
@@ -58,6 +65,7 @@ class ConfigRegistry:
 
     # -- read -----------------------------------------------------------
     def retrieve(self, host: str, task: str) -> Dict[str, Any]:
+        faults.fault_point("registry.retrieve")
         try:
             with open(self._path(host, task)) as f:
                 return json.load(f)
@@ -79,19 +87,28 @@ class ConfigRegistry:
 
     # -- watch ----------------------------------------------------------
     def wait_for(self, host: str, task: str, timeout_s: float = 30.0,
-                 poll_s: float = 0.1) -> Dict[str, Any]:
+                 poll_s: float = 0.1,
+                 policy: Optional[RetryPolicy] = None) -> Dict[str, Any]:
         """Block until a config appears (the worker-side retrieve-with-retry
-        the reference does against ZooKeeper)."""
-        deadline = time.monotonic() + timeout_s
-        while True:
-            try:
-                return self.retrieve(host, task)
-            except KeyError:  # not registered yet (or unregistered between
-                pass          # the check and the read) — keep waiting
-            if time.monotonic() >= deadline:
-                raise TimeoutError(f"config {host}/{task} not registered "
-                                   f"within {timeout_s}s")
-            time.sleep(poll_s)
+        the reference does against ZooKeeper). The poll loop is the shared
+        :class:`RetryPolicy` — by default a fixed ``poll_s`` interval
+        (multiplier=1, no jitter) bounded by ``timeout_s``; pass ``policy``
+        for backoff/jitter or an injectable sleep in tests. Transient read
+        faults (injected or real) are retried like not-yet-registered."""
+        self._path(host, task)  # invalid names fail NOW, not after the
+        # full timeout — only transient conditions belong in the poll loop
+        if policy is None:
+            policy = RetryPolicy(max_attempts=None, deadline_s=timeout_s,
+                                 base_delay_s=poll_s, multiplier=1.0,
+                                 rng=no_jitter,
+                                 retryable=(KeyError, OSError,
+                                            json.JSONDecodeError,
+                                            FaultInjected))
+        try:
+            return policy.call(self.retrieve, host, task)
+        except RetryError as e:
+            raise TimeoutError(f"config {host}/{task} not registered "
+                               f"within {timeout_s}s") from e.last
 
     def watch(self, host: str, task: str,
               callback: Callable[[Optional[Dict[str, Any]]], None],

@@ -17,6 +17,13 @@ plane: work assignment, liveness, and replicated metadata. Two backends:
 
 Job lifecycle mirrors the reference (pending → claimed → done, with requeue
 on failure — JobFailed/ClearWorker protocol, actor/core/protocol/).
+
+Resilience: every FileStateTracker publish goes through the shared
+``RetryPolicy`` (transient I/O errors on GCS-fuse/NFS retry with jittered
+backoff instead of killing a worker) and declares the
+``statetracker.write`` fault point; ``heartbeat.post`` fires on every
+heartbeat of either backend so chaos tests can starve liveness
+tracker-agnostically.
 """
 
 from __future__ import annotations
@@ -31,9 +38,30 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from deeplearning4j_tpu.utils.fileio import atomic_write_text
+from deeplearning4j_tpu.resilience import (
+    FaultInjected,
+    RetryError,
+    RetryPolicy,
+    faults,
+)
+from deeplearning4j_tpu.utils.fileio import (
+    atomic_write_bytes,
+    atomic_write_text,
+)
 
 logger = logging.getLogger(__name__)
+
+#: transient classes a shared-filesystem tracker may hit and injected
+#: faults tests raise; ValueError covers torn non-atomic media reads
+#: (json decode errors subclass it)
+_TRANSIENT = (OSError, FaultInjected, ValueError)
+
+
+def default_tracker_retry_policy() -> RetryPolicy:
+    """Small/fast: control-plane writes are tiny, so four attempts inside
+    ~0.3 s catches transient shared-fs hiccups without stalling training."""
+    return RetryPolicy(max_attempts=4, base_delay_s=0.01, max_delay_s=0.1,
+                       retryable=_TRANSIENT)
 
 
 @dataclass
@@ -185,6 +213,7 @@ class InMemoryStateTracker(StateTracker):
             return [Job(**j.to_json()) for j in out]
 
     def heartbeat(self, worker_id: str) -> None:
+        faults.fault_point("heartbeat.post")
         with self._lock:
             self._beats[worker_id] = time.time()
 
@@ -262,27 +291,51 @@ class FileStateTracker(StateTracker):
     needed and no two claimers can ever hold the same job).
     """
 
-    def __init__(self, root: str):
+    def __init__(self, root: str,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.root = root
+        self.retry_policy = retry_policy or default_tracker_retry_policy()
         self._lock_fds: Dict[str, int] = {}
         for sub in ("jobs", "beats", "meta", "locks", "tmp"):
             os.makedirs(os.path.join(root, sub), exist_ok=True)
 
     # -- helpers --
-    def _atomic_write(self, path: str, data: str) -> None:
+    def _atomic_write(self, path: str, data: str,
+                      durable: bool = True) -> None:
         # staged in a separate tmp/ dir so directory listings of jobs/ and
-        # beats/ never see half-written entries
-        atomic_write_text(path, data, tmp_dir=os.path.join(self.root, "tmp"))
+        # beats/ never see half-written entries; transient I/O failures
+        # (and injected ones) retry under the policy
+        def write():
+            faults.fault_point("statetracker.write")
+            atomic_write_text(path, data,
+                              tmp_dir=os.path.join(self.root, "tmp"),
+                              durable=durable)
+
+        self.retry_policy.call(write)
 
     def _job_path(self, jid: str) -> str:
         return os.path.join(self.root, "jobs", jid + ".json")
 
     def _read_job(self, jid: str) -> Optional[Job]:
+        # a decode error is a torn read on non-atomic shared media (rename
+        # is atomic locally; gcsfuse/NFS caching is not) — retry it as
+        # transient before concluding the job is unreadable. A missing
+        # file is a definitive answer, not a fault: never retried.
+        def read():
+            try:
+                with open(self._job_path(jid)) as f:
+                    return Job.from_json(json.load(f))
+            except FileNotFoundError:
+                return None
+
         try:
-            with open(self._job_path(jid)) as f:
-                return Job.from_json(json.load(f))
-        except (FileNotFoundError, json.JSONDecodeError):
+            return self.retry_policy.call(read)
+        except RetryError as e:  # transient class exhausted its retries
+            logger.warning("job %s unreadable after retries: %s", jid, e)
             return None
+        # anything non-retryable (e.g. TypeError from a schema-mismatched
+        # job file) propagates: a real bug must crash loudly, not make the
+        # job silently vanish from jobs()/claim_job()
 
     def _write_job(self, job: Job) -> None:
         self._atomic_write(self._job_path(job.job_id),
@@ -365,7 +418,22 @@ class FileStateTracker(StateTracker):
         return os.path.join(self.root, "beats", worker_id)
 
     def heartbeat(self, worker_id: str) -> None:
-        self._atomic_write(self._beat_path(worker_id), repr(time.time()))
+        faults.fault_point("heartbeat.post")
+
+        # beats bypass the statetracker.write fault point: background
+        # monitor threads post them continuously, and letting them bump a
+        # count-based schedule (fail_nth) installed for DATA writes would
+        # make that site nondeterministic. heartbeat.post is the beats'
+        # own injection site. durable=False: beats are ephemeral liveness
+        # data overwritten every interval — two fsyncs per beat would
+        # throttle the control plane on NFS/gcsfuse for durability nobody
+        # reads back.
+        def write():
+            atomic_write_text(self._beat_path(worker_id), repr(time.time()),
+                              tmp_dir=os.path.join(self.root, "tmp"),
+                              durable=False)
+
+        self.retry_policy.call(write)
 
     def last_heartbeat(self, worker_id: str) -> Optional[float]:
         try:
@@ -431,13 +499,14 @@ class FileStateTracker(StateTracker):
 
     def _save_array(self, target: str, value) -> None:
         import numpy as np
-        import tempfile as _tf
 
-        fd, tmp = _tf.mkstemp(dir=os.path.join(self.root, "tmp"),
-                              suffix=".npy")
-        with os.fdopen(fd, "wb") as f:
-            np.save(f, np.asarray(value))
-        os.replace(tmp, target)
+        def write():
+            faults.fault_point("statetracker.write")
+            atomic_write_bytes(target,
+                               lambda f: np.save(f, np.asarray(value)),
+                               tmp_dir=os.path.join(self.root, "tmp"))
+
+        self.retry_policy.call(write)
 
     def post_update(self, worker_id: str, update) -> None:
         name = f"{worker_id}@{uuid.uuid4().hex[:8]}.npy"

@@ -169,7 +169,9 @@ class DistributedTrainer:
     def __init__(self, tracker: StateTracker, router: WorkRouter,
                  performer_factory: Callable[[], WorkerPerformer],
                  num_workers: int = 2, poll_s: float = 0.01,
-                 max_attempts: int = 3, join_timeout_s: float = 60.0):
+                 max_attempts: int = 3, join_timeout_s: float = 60.0,
+                 eviction_timeout_s: Optional[float] = None,
+                 heartbeat_interval_s: float = 1.0):
         self.tracker = tracker
         self.router = router
         self.performer_factory = performer_factory
@@ -177,13 +179,44 @@ class DistributedTrainer:
         self.poll_s = poll_s
         self.max_attempts = max_attempts
         self.join_timeout_s = join_timeout_s
+        # MasterActor heartbeat eviction: with a timeout set, the master
+        # tick drops workers silent >= timeout and requeues their claimed
+        # jobs — a killed worker cannot wedge the run. The timeout must
+        # comfortably exceed the beat interval or live workers get evicted
+        # on ordinary scheduling jitter and their jobs double-executed.
+        if (eviction_timeout_s is not None
+                and eviction_timeout_s <= 2 * heartbeat_interval_s):
+            raise ValueError(
+                f"eviction_timeout_s ({eviction_timeout_s}) must exceed "
+                f"2x heartbeat_interval_s ({heartbeat_interval_s}): a "
+                f"single missed beat would evict a live worker")
+        self.eviction_timeout_s = eviction_timeout_s
+        self.heartbeat_interval_s = heartbeat_interval_s
         self.performers: List[WorkerPerformer] = []
         self.errors: List[str] = []
+        self.evicted: List[str] = []
+        self.monitors: Dict[str, Any] = {}
 
     def _worker_loop(self, worker_id: str, performer: WorkerPerformer,
                      stop: threading.Event) -> None:
+        from deeplearning4j_tpu.parallel.cluster import HeartbeatMonitor
+
+        # beats come from a background monitor thread, NOT the work loop:
+        # a long perform() (first-call XLA compile, a big job) must not go
+        # silent and get spuriously evicted + double-executed. Only a dead
+        # process — which takes its monitor thread with it — stops beating.
+        monitor = HeartbeatMonitor(
+            self.tracker, worker_id,
+            interval_s=self.heartbeat_interval_s).start()
+        self.monitors[worker_id] = monitor
+        try:
+            self._worker_poll(worker_id, performer, stop)
+        finally:
+            monitor.stop()
+
+    def _worker_poll(self, worker_id: str, performer: WorkerPerformer,
+                     stop: threading.Event) -> None:
         while not stop.is_set():
-            self.tracker.heartbeat(worker_id)
             job = self.tracker.claim_job(worker_id)
             if job is None:
                 time.sleep(self.poll_s)
@@ -225,6 +258,13 @@ class DistributedTrainer:
         try:
             while time.monotonic() < deadline:
                 self.router.step(self.num_workers)
+                if self.eviction_timeout_s is not None:
+                    stale = self.tracker.evict_stale(self.eviction_timeout_s)
+                    if stale:
+                        self.evicted.extend(stale)
+                        self.errors.append(
+                            f"evicted stale worker(s) {stale}; their "
+                            f"claimed jobs were requeued")
                 pending = self.tracker.jobs(status="pending")
                 claimed = self.tracker.jobs(status="claimed")
                 if not pending and not claimed:

@@ -1,0 +1,47 @@
+"""Resilience layer: deterministic fault injection, unified retry/backoff,
+hung-step watchdog.
+
+The control plane (``parallel/cluster.py``, ``parallel/statetracker.py``,
+``parallel/registry.py``, ``datasets/fetchers.py``) programs against this
+package instead of hand-rolling sleeps and bare ``except`` clauses:
+
+- :mod:`~deeplearning4j_tpu.resilience.faults` — named injection sites
+  activated per-test (``inject``) or per-process (``DL4J_FAULTS=``), with
+  deterministic schedules; zero overhead when inactive.
+- :mod:`~deeplearning4j_tpu.resilience.retry` — one ``RetryPolicy``
+  (exponential backoff + full jitter, deadline, retryable filter,
+  injectable sleep) replacing every ad-hoc retry loop.
+- :mod:`~deeplearning4j_tpu.resilience.watchdog` — ``StepWatchdog`` flags
+  hung training steps past a deadline (the slow/hung-host detector SPMD
+  needs, since a blocked collective never crashes).
+
+Checkpoint integrity verification lives with its writer
+(``parallel.cluster.FaultTolerantTrainer``): sha256 manifest sidecars on
+save, verify + fall back to the next-older checkpoint on resume. See
+``docs/resilience.md`` for the failure model.
+"""
+
+from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultPoint,
+    clear,
+    delay,
+    fail_nth,
+    fail_rate,
+    fail_times,
+    fault_point,
+    inject,
+    install,
+    install_from_env,
+    parse_spec,
+    uninstall,
+)
+from deeplearning4j_tpu.resilience.retry import (  # noqa: F401
+    RetryError,
+    RetryPolicy,
+    no_jitter,
+)
+from deeplearning4j_tpu.resilience.watchdog import StepWatchdog  # noqa: F401
+
+# chaos runs of real entry points: DL4J_FAULTS takes effect on first import
+install_from_env()

@@ -1,9 +1,12 @@
 """Cluster runtime tests: state tracker job lifecycle, heartbeat eviction,
 fault-tolerant checkpoint/resume (the reference's MasterActor heartbeat +
 ModelSavingActor semantics, SURVEY §3.4/§5, tested in-process the way the
-reference uses BaseTestDistributed)."""
+reference uses BaseTestDistributed) — plus chaos cases proving end-to-end
+recovery under injected faults (corrupt newest checkpoint → fallback to
+older; hung worker → eviction → requeue → run completes)."""
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -21,6 +24,19 @@ from deeplearning4j_tpu.parallel import (
     InMemoryStateTracker,
     initialize_distributed,
 )
+from deeplearning4j_tpu.resilience import (
+    RetryPolicy,
+    fail_times,
+    faults,
+    inject,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
 
 
 def toy(n=64, d=6, c=3, seed=0):
@@ -200,3 +216,357 @@ class TestReviewRegressions:
         os.utime(lock, (old, old))
         j = tr.claim_job("w2")
         assert j is not None and j.job_id == jid
+
+
+# ---------------------------------------------------------------------------
+# chaos: verified checkpoint recovery
+# ---------------------------------------------------------------------------
+
+
+def _two_checkpoints(tmp_path, seed=3):
+    """Train 4 iters → save, 4 more → save. Returns (ft, older, newer)."""
+    ds = toy()
+    net = make_net(seed=seed)
+    ft = FaultTolerantTrainer(net, str(tmp_path / "ck"), checkpoint_every=4)
+    for _ in range(4):
+        net.fit(ds)
+    ft.save()
+    for _ in range(4):
+        net.fit(ds)
+    ft.save()
+    cks = ft.checkpoints()
+    assert len(cks) == 2
+    return ft, cks[0], cks[1]
+
+
+@pytest.mark.chaos
+class TestVerifiedRecovery:
+    def test_manifest_written_and_pruned_with_checkpoint(self, tmp_path):
+        ds = toy()
+        net = make_net()
+        ft = FaultTolerantTrainer(net, str(tmp_path / "ck"),
+                                  checkpoint_every=1, keep=2)
+        for _ in range(4):
+            net.fit(ds)
+            ft.save()
+        cks = ft.checkpoints()
+        assert len(cks) == 2
+        for ck in cks:
+            assert os.path.exists(ck + ".sha256")
+            assert ft.verify_checkpoint(ck) == "ok"
+        # pruned checkpoints took their sidecars with them
+        sidecars = [f for f in os.listdir(ft.dir) if f.endswith(".sha256")]
+        assert len(sidecars) == 2
+
+    def test_corrupt_newest_falls_back_to_older(self, tmp_path):
+        ft, older, newer = _two_checkpoints(tmp_path)
+        with open(newer, "wb") as f:
+            f.write(b"this is not a checkpoint")
+        assert ft.verify_checkpoint(newer) == "corrupt"
+
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        assert ft2.resume() is True
+        assert net2.iteration_count == 4  # the older checkpoint's state
+
+    def test_truncated_newest_falls_back(self, tmp_path):
+        ft, older, newer = _two_checkpoints(tmp_path)
+        size = os.path.getsize(newer)
+        with open(newer, "r+b") as f:
+            f.truncate(size // 2)  # partial write / power cut
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        assert ft2.resume() is True
+        assert net2.iteration_count == 4
+
+    def test_all_corrupt_raises_instead_of_fresh_start(self, tmp_path):
+        ft, older, newer = _two_checkpoints(tmp_path)
+        for ck in (older, newer):
+            with open(ck, "wb") as f:
+                f.write(b"garbage")
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        with pytest.raises(RuntimeError, match="corrupt"):
+            ft2.resume()
+
+    def test_legacy_checkpoint_without_manifest_still_loads(self, tmp_path):
+        ft, older, newer = _two_checkpoints(tmp_path)
+        os.unlink(newer + ".sha256")  # pre-manifest writer
+        assert ft.verify_checkpoint(newer) == "unverified"
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        assert ft2.resume() is True
+        assert net2.iteration_count == 8  # unverified but loadable: used
+
+    def test_unverified_corrupt_still_falls_back(self, tmp_path):
+        # no sidecar AND corrupt: the zip-load failure must fall through
+        ft, older, newer = _two_checkpoints(tmp_path)
+        os.unlink(newer + ".sha256")
+        with open(newer, "wb") as f:
+            f.write(b"garbage")
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        assert ft2.resume() is True
+        assert net2.iteration_count == 4
+
+    def test_resumed_fallback_continues_training(self, tmp_path):
+        ds = toy()
+        ft, older, newer = _two_checkpoints(tmp_path)
+        with open(newer, "wb") as f:
+            f.write(b"junk")
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        assert ft2.resume() is True
+        s0 = net2.score(ds)
+        for _ in range(4):
+            net2.fit(ds)
+        assert net2.score(ds) < s0  # recovered state trains on
+
+    def test_save_crash_injection_leaves_state_consistent(self, tmp_path):
+        from deeplearning4j_tpu.resilience import FaultInjected, fail_nth
+
+        ft, older, newer = _two_checkpoints(tmp_path)
+        net = ft.network
+        net.fit(toy())
+        with inject("checkpoint.save", fail_nth(1)):
+            with pytest.raises(FaultInjected):
+                ft.save()
+        # the failed save left no partial archive: both old checkpoints
+        # still verify and resume still works
+        assert ft.checkpoints() == [older, newer]
+        assert ft.verify_checkpoint(newer) == "ok"
+
+
+# ---------------------------------------------------------------------------
+# chaos: initialize_distributed retry path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestInitializeDistributedRetry:
+    CFG = ClusterConfig(coordinator_address="127.0.0.1:1", num_processes=2,
+                        process_id=0)
+
+    def test_injected_faults_exhaust_deterministically(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.01, seed=5,
+                             sleep=sleeps.append)
+        # faults fire before jax.distributed is ever touched
+        with inject("distributed.init", fail_times(10)):
+            with pytest.raises(RuntimeError, match="after 3 attempts"):
+                initialize_distributed(self.CFG, policy=policy)
+        assert len(sleeps) == 2  # attempts-1 backoffs, jittered+recorded
+        assert all(0.0 <= s <= 0.04 for s in sleeps)
+
+    def test_transient_init_then_success(self, monkeypatch):
+        import jax
+
+        calls = {"n": 0}
+
+        def flaky_init(**kw):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("coordinator not up yet")
+
+        monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                             sleep=lambda s: None)
+        assert initialize_distributed(self.CFG, policy=policy) is True
+        assert calls["n"] == 3
+
+    def test_legacy_knobs_seed_default_policy(self, monkeypatch):
+        import jax
+
+        def always_down(**kw):
+            raise RuntimeError("down")
+
+        monkeypatch.setattr(jax.distributed, "initialize", always_down)
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        with pytest.raises(RuntimeError, match="after 2 attempts"):
+            initialize_distributed(self.CFG, retries=2, retry_delay_s=0.001)
+
+
+class TestHeartbeatMonitorHardening:
+    def test_stop_idempotent(self):
+        tracker = InMemoryStateTracker()
+        m = HeartbeatMonitor(tracker, "w1", interval_s=0.02)
+        m.start()
+        m.stop()
+        m.stop()  # second stop is a no-op, not an error
+        assert tracker.last_heartbeat("w1") is not None
+
+    def test_rapid_stop_start_cycles_beat_cleanly(self):
+        tracker = InMemoryStateTracker()
+        m = HeartbeatMonitor(tracker, "w1", interval_s=0.01)
+        for _ in range(5):
+            m.start()
+            m.stop()
+        m.start()
+        time.sleep(0.06)
+        t1 = tracker.last_heartbeat("w1")
+        time.sleep(0.06)
+        t2 = tracker.last_heartbeat("w1")
+        m.stop()
+        assert t2 > t1  # exactly one live thread, still beating
+
+    def test_start_twice_single_thread(self):
+        tracker = InMemoryStateTracker()
+        m = HeartbeatMonitor(tracker, "w1", interval_s=0.02)
+        assert m.start() is m.start()
+        thread = m._thread
+        m.start()
+        assert m._thread is thread  # no second thread spawned
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: end-to-end — kill a worker mid-job, corrupt the newest checkpoint
+# ---------------------------------------------------------------------------
+
+
+class _DieFirstPerformer:
+    """Simulates a worker PROCESS dying mid-job: the first perform()
+    across the pool stops that worker's heartbeat monitor (a dead process
+    takes its monitor thread with it) and wedges forever. Later calls on
+    other workers run normally. Workers heartbeat from a background
+    monitor, so a merely-SLOW job keeps beating and is never evicted —
+    only this death shape goes silent."""
+
+    _lock = threading.Lock()
+    _dead = False
+
+    def __init__(self, worker_id, trainer_ref):
+        self.worker_id = worker_id
+        self.trainer_ref = trainer_ref
+        self.received = []
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._dead = False
+
+    @classmethod
+    def factory(cls, trainer_ref):
+        made = []
+
+        def make():
+            p = cls(f"worker-{len(made)}", trainer_ref)
+            made.append(p)
+            return p
+
+        return make
+
+    def _die_if_first(self) -> bool:
+        cls = type(self)
+        with cls._lock:
+            should_die = not cls._dead
+            cls._dead = True
+        if should_die:
+            self.trainer_ref["trainer"].monitors[self.worker_id].stop()
+            threading.Event().wait()  # never set: wedged forever
+        return should_die
+
+    def perform(self, payload):
+        self._die_if_first()
+        return np.asarray(payload["value"], np.float32)
+
+    def update(self, params):
+        self.received.append(np.asarray(params))
+
+
+@pytest.mark.chaos
+class TestChaosEndToEnd:
+    def test_worker_crash_eviction_requeue_completes(self, tmp_path):
+        from deeplearning4j_tpu.parallel import (
+            DistributedTrainer,
+            IterativeReduceWorkRouter,
+        )
+
+        _DieFirstPerformer.reset()
+        tracker = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tracker)
+        for i in range(4):
+            tracker.add_job({"value": [float(i + 1)]})
+        ref = {}
+        trainer = DistributedTrainer(
+            tracker, router, _DieFirstPerformer.factory(ref),
+            num_workers=2, poll_s=0.01, join_timeout_s=0.2,
+            heartbeat_interval_s=0.05,
+            eviction_timeout_s=0.3)  # MasterActor-style liveness eviction
+        ref["trainer"] = trainer
+        params = trainer.train(timeout_s=30.0)
+        # exactly the dead worker was evicted (the survivor kept beating
+        # from its background monitor) and its claimed job was requeued …
+        assert len(set(trainer.evicted)) == 1
+        # … and every job still completed (on the surviving worker)
+        assert len(tracker.jobs(status="done")) == 4
+        assert tracker.jobs(status="pending") == []
+        assert params is not None
+
+    def test_corrupt_checkpoint_and_worker_crash_full_recovery(
+            self, tmp_path):
+        """The acceptance scenario, end to end: the newest checkpoint is
+        corrupted AND one worker dies mid-job — resume() restores the
+        next-older verified checkpoint, the master evicts the dead worker
+        and requeues its job, and distributed training completes."""
+        from deeplearning4j_tpu.parallel import (
+            DistributedTrainer,
+            IterativeReduceWorkRouter,
+            NetworkWorkPerformer,
+        )
+
+        # -- phase 1: crash-restart with a corrupted newest checkpoint --
+        ft, older, newer = _two_checkpoints(tmp_path, seed=3)
+        with open(newer, "wb") as f:
+            f.write(b"flipped bits")
+        net = make_net(seed=99)  # relaunched process, fresh init
+        ft2 = FaultTolerantTrainer(net, ft.dir)
+        assert ft2.resume() is True
+        assert net.iteration_count == 4  # next-older verified checkpoint
+
+        # -- phase 2: finish training distributed, surviving one death --
+        ref = {}
+
+        class DieFirstNetworkPerformer(_DieFirstPerformer,
+                                       NetworkWorkPerformer):
+            def __init__(self, worker_id, trainer_ref, conf_json):
+                NetworkWorkPerformer.__init__(self, conf_json)
+                self.worker_id = worker_id
+                self.trainer_ref = trainer_ref
+
+            def perform(self, payload):
+                self._die_if_first()
+                return NetworkWorkPerformer.perform(self, payload)
+
+            def update(self, params):
+                NetworkWorkPerformer.update(self, params)
+
+        DieFirstNetworkPerformer.reset()
+        made = []
+
+        def factory():
+            p = DieFirstNetworkPerformer(f"worker-{len(made)}", ref,
+                                         conf_json)
+            made.append(p)
+            return p
+
+        conf_json = net.conf.to_json()
+        tracker = InMemoryStateTracker()
+        router = IterativeReduceWorkRouter(tracker)
+        ds = toy()
+        for start in range(0, 48, 16):
+            tracker.add_job({
+                "features": np.asarray(
+                    ds.features[start:start + 16]).tolist(),
+                "labels": np.asarray(ds.labels[start:start + 16]).tolist(),
+            })
+        trainer = DistributedTrainer(
+            tracker, router, factory,
+            num_workers=2, poll_s=0.01, join_timeout_s=0.2,
+            heartbeat_interval_s=0.05, eviction_timeout_s=0.4)
+        ref["trainer"] = trainer
+        params = trainer.train(timeout_s=60.0)
+        assert trainer.evicted  # the dead worker was noticed …
+        assert len(tracker.jobs(status="done")) == 3  # … and its job ran
+        assert params is not None and np.all(np.isfinite(params))
+        assert params.shape == net.get_flat_params().shape

@@ -1,0 +1,712 @@
+"""Resilience layer: deterministic fault injection, unified retry/backoff,
+hung-step watchdog, and their control-plane integrations (statetracker
+writes, registry polls, fetcher downloads, atomic file publication)."""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.resilience import (
+    FaultInjected,
+    FaultPoint,
+    RetryError,
+    RetryPolicy,
+    StepWatchdog,
+    delay,
+    fail_nth,
+    fail_rate,
+    fail_times,
+    fault_point,
+    inject,
+    no_jitter,
+    parse_spec,
+)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.utils.fileio import atomic_write_text
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def _recording_policy(**kw):
+    sleeps = []
+    kw.setdefault("base_delay_s", 0.01)
+    policy = RetryPolicy(sleep=sleeps.append, **kw)
+    return policy, sleeps
+
+
+class TestRetryPolicy:
+    def test_first_try_success_no_sleep(self):
+        policy, sleeps = _recording_policy(max_attempts=5)
+        assert policy.call(lambda: 42) == 42
+        assert sleeps == []
+
+    def test_transient_then_success(self):
+        policy, sleeps = _recording_policy(max_attempts=5, seed=0)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+        assert calls["n"] == 3
+        assert len(sleeps) == 2
+
+    def test_backoff_deterministic_under_seed(self):
+        p1 = RetryPolicy(max_attempts=8, base_delay_s=0.1, max_delay_s=2.0,
+                         seed=7, sleep=lambda s: None)
+        p2 = RetryPolicy(max_attempts=8, base_delay_s=0.1, max_delay_s=2.0,
+                         seed=7, sleep=lambda s: None)
+        d1 = [p1.delay_for(k) for k in range(1, 9)]
+        d2 = [p2.delay_for(k) for k in range(1, 9)]
+        assert d1 == d2  # same seed → identical jitter sequence
+        for k, d in enumerate(d1, start=1):
+            assert 0.0 <= d <= min(2.0, 0.1 * 2 ** (k - 1))
+
+    def test_no_jitter_gives_raw_exponential(self):
+        policy = RetryPolicy(max_attempts=10, base_delay_s=0.1,
+                             max_delay_s=0.8, rng=no_jitter,
+                             sleep=lambda s: None)
+        got = [policy.delay_for(k) for k in range(1, 6)]
+        assert got == pytest.approx([0.1, 0.2, 0.4, 0.8, 0.8])  # capped
+
+    def test_full_jitter_spreads(self):
+        policy = RetryPolicy(max_attempts=2, base_delay_s=1.0,
+                             max_delay_s=1.0, seed=3, sleep=lambda s: None)
+        draws = {round(policy.delay_for(1), 6) for _ in range(32)}
+        assert len(draws) > 16  # actually jittered, not a constant
+
+    def test_non_retryable_propagates_immediately(self):
+        policy, sleeps = _recording_policy(max_attempts=5,
+                                           retryable=(OSError,))
+        with pytest.raises(KeyError):
+            policy.call(lambda: (_ for _ in ()).throw(KeyError("nope")))
+        assert sleeps == []
+
+    def test_retryable_predicate_form(self):
+        policy, sleeps = _recording_policy(
+            max_attempts=3,
+            retryable=lambda e: "retry-me" in str(e))
+        with pytest.raises(ValueError):
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("other")))
+        assert sleeps == []
+
+    def test_exhaustion_raises_retry_error(self):
+        policy, sleeps = _recording_policy(max_attempts=3)
+
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(RetryError) as ei:
+            policy.call(always)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last, OSError)
+        assert isinstance(ei.value.__cause__, OSError)
+        assert len(sleeps) == 2  # no sleep after the final attempt
+
+    def test_deadline_bounds_attempts(self):
+        clock = {"t": 0.0}
+
+        def monotonic():
+            return clock["t"]
+
+        def sleep(s):
+            clock["t"] += s
+
+        policy = RetryPolicy(max_attempts=None, deadline_s=1.0,
+                             base_delay_s=0.4, multiplier=1.0,
+                             rng=no_jitter, sleep=sleep,
+                             monotonic=monotonic)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError("down")
+
+        with pytest.raises(RetryError, match="deadline"):
+            policy.call(always)
+        # 0.4s per retry under a 1.0s budget → 3 attempts, 2 sleeps
+        assert calls["n"] == 3
+
+    def test_on_retry_hook(self):
+        seen = []
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                             on_retry=lambda a, e, d: seen.append((a, str(e))),
+                             sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("first")
+            return 1
+
+        policy.call(flaky)
+        assert seen == [(1, "first")]
+
+    def test_unbounded_policy_rejected(self):
+        with pytest.raises(ValueError, match="max_attempts or deadline_s"):
+            RetryPolicy(max_attempts=None, deadline_s=None)
+
+    def test_decorator_form(self):
+        policy, _ = _recording_policy(max_attempts=2)
+        calls = {"n": 0}
+
+        @policy.retrying
+        def once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("x")
+            return "done"
+
+        assert once() == "done"
+
+
+# ---------------------------------------------------------------------------
+# fault points
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestFaultPoints:
+    def test_inactive_is_noop(self):
+        fault_point("nothing.installed")  # no error, no state
+
+    def test_inject_activates_and_deactivates(self):
+        with inject("site.a", fail_times(100)):
+            with pytest.raises(FaultInjected):
+                fault_point("site.a")
+            fault_point("site.b")  # other sites unaffected
+        fault_point("site.a")  # deactivated on exit
+
+    def test_inject_restores_previous_schedule(self):
+        with inject("s", fail_times(100)):
+            with inject("s", delay(0)):
+                fault_point("s")  # inner: delay, no raise
+            with pytest.raises(FaultInjected):
+                fault_point("s")  # outer restored
+
+    def test_fail_nth_fires_exactly_nth(self):
+        with inject("s", fail_nth(3)):
+            fault_point("s")
+            fault_point("s")
+            with pytest.raises(FaultInjected):
+                fault_point("s")
+            fault_point("s")  # 4th passes again
+
+    def test_fail_times_fires_first_k(self):
+        with inject("s", fail_times(2)):
+            for _ in range(2):
+                with pytest.raises(FaultInjected):
+                    fault_point("s")
+            fault_point("s")
+
+    def test_custom_exception_type(self):
+        with inject("s", fail_nth(1, exc=OSError)):
+            with pytest.raises(OSError):
+                fault_point("s")
+
+    def test_fail_rate_deterministic(self):
+        def run():
+            hits = []
+            with inject("s", fail_rate(0.5, seed=42)):
+                for i in range(32):
+                    try:
+                        fault_point("s")
+                        hits.append(0)
+                    except FaultInjected:
+                        hits.append(1)
+            return hits
+
+        first, second = run(), run()
+        assert first == second  # seeded → replayable
+        assert 0 < sum(first) < 32  # actually fires sometimes
+
+    def test_delay_sleeps(self):
+        with inject("s", delay(30)):
+            t0 = time.monotonic()
+            fault_point("s")
+            assert time.monotonic() - t0 >= 0.025
+
+    def test_fault_point_handle(self):
+        fp = FaultPoint("handle.site")
+        fp()  # inactive no-op
+        with inject("handle.site", fail_nth(1)):
+            with pytest.raises(FaultInjected):
+                fp()
+        assert "handle.site" in repr(fp)
+
+    def test_parse_spec(self):
+        scheds = parse_spec(
+            "statetracker.write=fail_nth:2;heartbeat.post=delay:1;"
+            "fetcher.download=fail_rate:0.5:9")
+        assert set(scheds) == {"statetracker.write", "heartbeat.post",
+                               "fetcher.download"}
+        scheds["statetracker.write"]("x")  # 1st passes
+        with pytest.raises(FaultInjected):
+            scheds["statetracker.write"]("x")  # 2nd fires
+
+    def test_parse_spec_rejects_garbage(self):
+        with pytest.raises(ValueError, match="bad DL4J_FAULTS"):
+            parse_spec("whatisthis")
+        with pytest.raises(ValueError, match="bad DL4J_FAULTS"):
+            parse_spec("site=unknown_schedule:1")
+
+    def test_install_from_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_FAULTS", "env.site=fail_nth:1")
+        assert faults.install_from_env() == 1
+        with pytest.raises(FaultInjected):
+            fault_point("env.site")
+        monkeypatch.delenv("DL4J_FAULTS")
+        assert faults.install_from_env() == 0
+
+
+# ---------------------------------------------------------------------------
+# StepWatchdog
+# ---------------------------------------------------------------------------
+
+
+class TestStepWatchdog:
+    def test_fires_on_stall(self):
+        fired = threading.Event()
+        stalls = []
+
+        def on_stall(s):
+            stalls.append(s)
+            fired.set()
+
+        with StepWatchdog(deadline_s=0.05, on_stall=on_stall,
+                          poll_s=0.01):
+            assert fired.wait(2.0)
+        assert stalls and stalls[0] >= 0.05
+        assert len(stalls) == 1  # once per episode, no repeat-fire spam
+
+    def test_beats_prevent_firing(self):
+        stalls = []
+        with StepWatchdog(deadline_s=0.08, on_stall=stalls.append,
+                          poll_s=0.01) as wd:
+            for _ in range(10):
+                time.sleep(0.02)
+                wd.beat()
+        assert stalls == []
+        assert wd.beats >= 10
+
+    def test_new_beat_rearms(self):
+        fired = threading.Event()
+        stalls = []
+
+        def on_stall(s):
+            stalls.append(s)
+            fired.set()
+
+        with StepWatchdog(deadline_s=0.05, on_stall=on_stall,
+                          poll_s=0.01) as wd:
+            assert fired.wait(2.0)  # first stall episode
+            fired.clear()
+            wd.beat()  # progress resumes → re-armed
+            assert fired.wait(2.0)  # second stall episode fires again
+        assert len(stalls) == 2
+
+    def test_repeat_every(self):
+        stalls = []
+        with StepWatchdog(deadline_s=0.03, on_stall=stalls.append,
+                          poll_s=0.01, repeat_every_s=0.03):
+            time.sleep(0.3)
+        assert len(stalls) >= 2  # escalating re-fires during one stall
+
+    def test_stop_idempotent_and_restartable(self):
+        wd = StepWatchdog(deadline_s=10.0, poll_s=0.01)
+        wd.start()
+        wd.stop()
+        wd.stop()  # idempotent
+        wd.start()  # restart after stop
+        wd.stop()
+
+    def test_bad_deadline_rejected(self):
+        with pytest.raises(ValueError):
+            StepWatchdog(deadline_s=0.0)
+
+    def test_callback_exception_does_not_kill_thread(self):
+        calls = []
+
+        def bad(s):
+            calls.append(s)
+            raise RuntimeError("callback bug")
+
+        with StepWatchdog(deadline_s=0.02, on_stall=bad, poll_s=0.01,
+                          repeat_every_s=0.02) as wd:
+            time.sleep(0.15)
+            assert wd._thread.is_alive()
+        assert len(calls) >= 2  # survived its own callback raising
+
+
+# ---------------------------------------------------------------------------
+# fileio satellite: bare filenames + durability
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicWriteText:
+    def test_bare_filename(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        atomic_write_text("bare.json", '{"a": 1}')  # dirname("") crashed
+        with open("bare.json") as f:
+            assert json.load(f) == {"a": 1}
+
+    def test_no_temp_litter_on_failure(self, tmp_path):
+        target = str(tmp_path / "out.txt")
+
+        class Boom:
+            def __str__(self):
+                raise RuntimeError("boom")
+
+        with pytest.raises(TypeError):
+            atomic_write_text(target, Boom())  # f.write rejects non-str
+        assert os.listdir(tmp_path) == []  # tempfile cleaned up
+
+    def test_overwrite_atomic(self, tmp_path):
+        target = str(tmp_path / "cfg.json")
+        atomic_write_text(target, "one")
+        atomic_write_text(target, "two")
+        with open(target) as f:
+            assert f.read() == "two"
+
+
+# ---------------------------------------------------------------------------
+# control-plane integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestTrackerResilience:
+    def test_write_faults_retried(self, tmp_path):
+        from deeplearning4j_tpu.parallel import FileStateTracker
+
+        tr = FileStateTracker(
+            str(tmp_path / "t"),
+            retry_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                     retryable=(OSError,),
+                                     sleep=lambda s: None))
+        with inject("statetracker.write", fail_times(2, exc=OSError)):
+            jid = tr.add_job({"x": 1})  # survives 2 injected write faults
+        assert tr.jobs(status="pending")[0].job_id == jid
+
+    def test_write_faults_exhaust(self, tmp_path):
+        from deeplearning4j_tpu.parallel import FileStateTracker
+
+        tr = FileStateTracker(
+            str(tmp_path / "t"),
+            retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                                     retryable=(OSError,),
+                                     sleep=lambda s: None))
+        with inject("statetracker.write", fail_times(10, exc=OSError)):
+            with pytest.raises(RetryError):
+                tr.add_job({"x": 1})
+
+    def test_torn_job_read_retried(self, tmp_path):
+        from deeplearning4j_tpu.parallel import FileStateTracker
+
+        tr = FileStateTracker(str(tmp_path / "t"))
+        jid = tr.add_job({"x": 1})
+        path = tr._job_path(jid)
+        with open(path) as f:
+            good = f.read()
+
+        # torn read: the reader first sees half a JSON document (the
+        # non-atomic-visibility window of gcsfuse/NFS); the backoff sleep
+        # doubles as "the write completes" before the retry
+        def heal(_seconds):
+            with open(path, "w") as f:
+                f.write(good)
+
+        tr.retry_policy = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                      retryable=(ValueError,), sleep=heal)
+        with open(path, "w") as f:
+            f.write(good[: len(good) // 2])
+        j = tr._read_job(jid)  # retries through the decode error
+        assert j is not None and j.job_id == jid
+
+    def test_heartbeat_fault_skips_beat_not_thread(self):
+        from deeplearning4j_tpu.parallel import InMemoryStateTracker
+        from deeplearning4j_tpu.parallel.cluster import HeartbeatMonitor
+
+        tracker = InMemoryStateTracker()
+        # every 2nd post fails — the monitor thread must survive and keep
+        # posting on the other intervals
+        with inject("heartbeat.post", fail_rate(0.5, seed=1)):
+            with HeartbeatMonitor(tracker, "w1", interval_s=0.01):
+                time.sleep(0.2)
+        assert tracker.last_heartbeat("w1") is not None
+
+    def test_registry_wait_for_rides_through_faults(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ConfigRegistry
+
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        reg.register("h", "t", {"lr": 0.1})
+        with inject("registry.retrieve", fail_times(2, exc=OSError)):
+            got = reg.wait_for(
+                "h", "t",
+                policy=RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                                   retryable=(KeyError, OSError),
+                                   sleep=lambda s: None))
+        assert got == {"lr": 0.1}
+
+    def test_registry_wait_for_times_out(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ConfigRegistry
+
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        with pytest.raises(TimeoutError):
+            reg.wait_for("h", "missing", timeout_s=0.1, poll_s=0.02)
+
+
+@pytest.mark.chaos
+class TestFetcherDownloadResilience:
+    def _opener(self, payload=b"idx-bytes", log=None):
+        def opener(url):
+            if log is not None:
+                log.append(url)
+            return io.BytesIO(payload)
+
+        return opener
+
+    def test_download_retries_then_succeeds(self, tmp_path):
+        from deeplearning4j_tpu.datasets.fetchers import download_file
+
+        sleeps = []
+        urls = []
+        dest = str(tmp_path / "data" / "file.gz")
+        with inject("fetcher.download", fail_times(2, exc=OSError)):
+            out = download_file(
+                "https://example.invalid/file.gz", dest,
+                policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                   retryable=(OSError,),
+                                   sleep=sleeps.append),
+                opener=self._opener(log=urls))
+        assert out == dest
+        with open(dest, "rb") as f:
+            assert f.read() == b"idx-bytes"
+        assert len(sleeps) == 2  # two injected failures, two backoffs
+        assert len(urls) == 1  # faults fired before the opener ran
+
+    def test_download_exhaustion_raises_and_leaves_no_partial(self,
+                                                              tmp_path):
+        from deeplearning4j_tpu.datasets.fetchers import download_file
+
+        dest = str(tmp_path / "file.gz")
+        with inject("fetcher.download", fail_times(10, exc=OSError)):
+            with pytest.raises(RetryError):
+                download_file(
+                    "https://example.invalid/file.gz", dest,
+                    policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                       retryable=(OSError,),
+                                       sleep=lambda s: None),
+                    opener=self._opener())
+        assert not os.path.exists(dest)
+        assert os.listdir(tmp_path) == []  # no tempfile litter either
+
+    def test_zero_egress_default(self, monkeypatch):
+        from deeplearning4j_tpu.datasets import fetchers
+
+        monkeypatch.delenv("DL4J_TPU_ALLOW_DOWNLOAD", raising=False)
+        assert fetchers.downloads_allowed() is False
+        assert fetchers._maybe_download_mnist("/nope",
+                                              "train-images-idx3-ubyte") \
+            is None
+
+
+class TestAtomicWriteBytes:
+    def test_round_trip_and_cleanup(self, tmp_path):
+        from deeplearning4j_tpu.utils.fileio import atomic_write_bytes
+
+        target = str(tmp_path / "blob.bin")
+        atomic_write_bytes(target, lambda f: f.write(b"\x00\x01payload"))
+        with open(target, "rb") as f:
+            assert f.read() == b"\x00\x01payload"
+
+        def boom(f):
+            f.write(b"partial")
+            raise RuntimeError("writer died")
+
+        with pytest.raises(RuntimeError):
+            atomic_write_bytes(str(tmp_path / "never.bin"), boom)
+        assert sorted(os.listdir(tmp_path)) == ["blob.bin"]  # no litter
+
+
+@pytest.mark.chaos
+class TestReviewRegressions:
+    def test_wait_for_retries_injected_faults_by_default(self, tmp_path):
+        """The documented registry.retrieve injection site must be retried
+        by wait_for's DEFAULT policy, not crash it (its stated contract)."""
+        from deeplearning4j_tpu.parallel import ConfigRegistry
+
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        reg.register("h", "t", {"ok": 1})
+        with inject("registry.retrieve", fail_times(2)):  # FaultInjected
+            assert reg.wait_for("h", "t", timeout_s=5.0,
+                                poll_s=0.01) == {"ok": 1}
+
+    def test_cached_images_do_not_suppress_label_download(
+            self, tmp_path, monkeypatch):
+        """With images already local but labels missing, enabling
+        downloads must fetch the LABEL file, not silently go synthetic."""
+        from deeplearning4j_tpu.datasets import fetchers
+
+        monkeypatch.setenv("DL4J_TPU_ALLOW_DOWNLOAD", "1")
+        base = str(tmp_path / "mnist")
+        os.makedirs(base)
+        with open(os.path.join(base, "train-images-idx3-ubyte.gz"),
+                  "wb") as f:
+            f.write(b"cached")
+        asked = []
+        monkeypatch.setattr(
+            fetchers, "download_file",
+            lambda url, dest, **kw: asked.append(os.path.basename(dest))
+            or dest)
+        # the fetcher's per-file resolution: each file independently
+        img = fetchers._first_existing(base, "train-images-idx3-ubyte") \
+            or fetchers._maybe_download_mnist(base,
+                                              "train-images-idx3-ubyte")
+        lbl = fetchers._first_existing(base, "train-labels-idx1-ubyte") \
+            or fetchers._maybe_download_mnist(base,
+                                              "train-labels-idx1-ubyte")
+        assert img is not None
+        assert "train-labels-idx1-ubyte.gz" in asked
+
+    def test_heartbeat_writes_skip_fsync(self, tmp_path, monkeypatch):
+        """Beats are ephemeral: the durable fsync path must not run for
+        them (hot-path regression guard)."""
+        import deeplearning4j_tpu.utils.fileio as fileio
+        from deeplearning4j_tpu.parallel import FileStateTracker
+
+        synced = []
+        real_fsync = os.fsync
+        monkeypatch.setattr(
+            fileio.os, "fsync",
+            lambda fd: synced.append(fd) or real_fsync(fd))
+        tr = FileStateTracker(str(tmp_path / "t"))
+        tr.heartbeat("w1")
+        assert synced == []  # no fsync on the beat path
+        tr.put_meta("k", {"v": 1})
+        assert synced  # durable data still fsyncs
+
+    def test_wait_for_invalid_name_fails_fast(self, tmp_path):
+        """A name-validation error is permanent: it must raise NOW, not
+        spin for the whole timeout and surface as TimeoutError."""
+        from deeplearning4j_tpu.parallel import ConfigRegistry
+
+        reg = ConfigRegistry(str(tmp_path / "reg"))
+        t0 = time.monotonic()
+        with pytest.raises(ValueError, match="invalid registry name"):
+            reg.wait_for("../escape", "task", timeout_s=30.0)
+        assert time.monotonic() - t0 < 1.0
+
+    def test_trainer_rejects_eviction_below_beat_interval(self):
+        from deeplearning4j_tpu.parallel import (
+            DistributedTrainer,
+            InMemoryStateTracker,
+            IterativeReduceWorkRouter,
+        )
+
+        tr = InMemoryStateTracker()
+        with pytest.raises(ValueError, match="heartbeat_interval_s"):
+            DistributedTrainer(tr, IterativeReduceWorkRouter(tr),
+                               lambda: None, eviction_timeout_s=0.5,
+                               heartbeat_interval_s=1.0)
+
+    def test_schema_mismatched_job_file_crashes_loudly(self, tmp_path):
+        """Valid JSON that isn't a Job must raise (a real bug), not make
+        the job silently vanish from jobs()/claim_job()."""
+        from deeplearning4j_tpu.parallel import FileStateTracker
+
+        tr = FileStateTracker(str(tmp_path / "t"))
+        jid = tr.add_job({"x": 1})
+        with open(tr._job_path(jid), "w") as f:
+            f.write('{"not_a_job_field": true}')
+        with pytest.raises(TypeError):
+            tr.jobs()
+
+    def test_bare_exception_class_retryable(self):
+        """retryable=OSError (no tuple) must mean isinstance, not a
+        predicate call — and must never swallow KeyboardInterrupt."""
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                             retryable=OSError, sleep=sleeps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+        with pytest.raises(ValueError):  # not an OSError: propagates
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("bug")))
+        with pytest.raises(KeyboardInterrupt):
+            policy.call(
+                lambda: (_ for _ in ()).throw(KeyboardInterrupt()))
+
+    def test_invalid_download_never_poisons_cache(self, tmp_path,
+                                                  monkeypatch):
+        """A mirror error page served with HTTP 200 must be discarded,
+        not committed under the dataset's real name."""
+        from deeplearning4j_tpu.datasets import fetchers
+
+        monkeypatch.setenv("DL4J_TPU_ALLOW_DOWNLOAD", "1")
+        base = str(tmp_path / "mnist")
+
+        def fake_download(url, dest, **kw):
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(b"<html>404 not found</html>")
+            return dest
+
+        monkeypatch.setattr(fetchers, "download_file", fake_download)
+        got = fetchers._maybe_download_mnist(base,
+                                             "train-images-idx3-ubyte")
+        assert got is None
+        assert not os.path.exists(
+            os.path.join(base, "train-images-idx3-ubyte.gz"))
+
+    def test_valid_idx_gz_accepts_real_header(self, tmp_path):
+        import gzip
+        import struct as _struct
+
+        from deeplearning4j_tpu.datasets.fetchers import _valid_idx_gz
+
+        path = str(tmp_path / "t.gz")
+        with gzip.open(path, "wb") as f:
+            f.write(_struct.pack(">IIII", 2051, 1, 2, 2))
+            f.write(bytes(4))
+        assert _valid_idx_gz(path) is True
+
+    def test_heartbeats_do_not_consume_write_fault_schedules(
+            self, tmp_path):
+        """Background beats must not bump count-based schedules installed
+        at statetracker.write — that site stays deterministic for DATA
+        writes; beats have their own heartbeat.post site."""
+        from deeplearning4j_tpu.parallel import FileStateTracker
+
+        tr = FileStateTracker(
+            str(tmp_path / "t"),
+            retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.001,
+                                     sleep=lambda s: None))
+        with inject("statetracker.write", fail_nth(1)):
+            for _ in range(5):
+                tr.heartbeat("w1")  # beats pass through untouched
+            with pytest.raises(RetryError):  # data write absorbs fault #1
+                tr.put_meta("k", 1)

@@ -328,6 +328,54 @@ def bench_infeed():
             "batch": batch, "n_batches": n_batches}
 
 
+def bench_eval():
+    """Inference/eval path: device-resident confusion accumulation vs the
+    host path (per-batch logit readback) on a stream of ragged batches.
+    Reports samples/sec both ways plus jit compile counts — the device
+    path must show exactly one compile per shape bucket and one host
+    transfer per evaluate() call (the PERF.md eval invariants)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import mnist_mlp
+
+    rng = np.random.default_rng(0)
+    # seven full batches + a ragged tail: two shape buckets total
+    sizes = [4096] * 7 + [1777]
+    batches = [DataSet(rng.random((b, 784), np.float32),
+                       np.eye(10, dtype=np.float32)[
+                           rng.integers(0, 10, b)])
+               for b in sizes]
+    total = sum(sizes)
+    net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+
+    def run(device):
+        t0 = time.perf_counter()
+        ev = net.evaluate(batches, device_accumulation=device)
+        # evaluate() ends on a host readback either way — already synced
+        return total / (time.perf_counter() - t0), ev
+
+    run(True)  # compile both bucket programs
+    device_sps, ev_dev = run(True)
+    run(False)
+    host_sps, ev_host = run(False)
+    if abs(ev_dev.accuracy() - ev_host.accuracy()) > 1e-12:
+        _log(f"eval: DEVICE/HOST ACCURACY MISMATCH "
+             f"{ev_dev.accuracy()} vs {ev_host.accuracy()}")
+    readbacks_per_call = net._eval_readbacks / 2  # two device runs above
+    _log(f"eval: {device_sps:,.0f} samples/sec device-resident, "
+         f"{host_sps:,.0f} host path ({device_sps / host_sps:.2f}x), "
+         f"{net._eval_step._cache_size()} compiles for "
+         f"{len(set(sizes))} buckets")
+    return {"device_samples_per_sec": round(device_sps, 1),
+            "host_samples_per_sec": round(host_sps, 1),
+            "speedup": round(device_sps / host_sps, 2),
+            "eval_compiles": net._eval_step._cache_size(),
+            "output_compiles": net._output_fn._cache_size(),
+            "host_transfers_per_call": readbacks_per_call,
+            "batches": len(sizes), "total_samples": total,
+            "accuracy_match": bool(
+                abs(ev_dev.accuracy() - ev_host.accuracy()) <= 1e-12)}
+
+
 def _transformer(t, vocab=8192, d=512, layers=8, heads=8, attn="auto",
                  remat=False, window=None):
     from deeplearning4j_tpu.models.transformer import TransformerLM
@@ -670,7 +718,8 @@ def main() -> None:
                 ("char_lstm", bench_char_lstm),
                 ("word2vec", bench_word2vec),
                 ("resnet18_cifar10", bench_resnet18),
-                ("infeed", bench_infeed)]
+                ("infeed", bench_infeed),
+                ("eval", bench_eval)]
     if only:
         known = {n for n, _ in sections} | {"transformer"}
         unknown = sorted(only - known)

@@ -3,6 +3,7 @@
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
 from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     AsyncDataSetIterator,
+    BucketedDataSetIterator,
     DataSetIterator,
     ListDataSetIterator,
     MultipleEpochsIterator,

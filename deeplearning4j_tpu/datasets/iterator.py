@@ -232,6 +232,50 @@ class ReconstructionDataSetIterator(DataSetIterator):
         return self.underlying.input_columns()  # labels are the features
 
 
+class BucketedDataSetIterator(DataSetIterator):
+    """Pads every batch up the shape-bucket ladder (batch axis) with a
+    mask-correct labels mask (perf/bucketing.pad_dataset), so downstream
+    jitted paths — fit, output, evaluate — compile once per BUCKET instead
+    of once per ragged shape. Epoch tails are the canonical case: a
+    256-example dataset at batch 100 yields 100/100/56, and the 56-row
+    tail would otherwise cost a full XLA compile (seconds under remote
+    compile — PERF.md) to train on 56 rows once.
+
+    Caveat: pad rows are inert only through row-independent and
+    mask-weighted computation. Train-mode BatchNormalization computes
+    batch statistics over ALL rows (no mask), so fitting through this
+    iterator skews a padded tail batch's mean/variance and the running
+    averages — don't wrap fit streams for batchnorm nets (evaluate/output
+    are unaffected: inference batchnorm uses stored stats)."""
+
+    def __init__(self, underlying: DataSetIterator, buckets=None):
+        self.underlying = underlying
+        self.buckets = buckets
+
+    def has_next(self):
+        return self.underlying.has_next()
+
+    def next(self, num=None):
+        from deeplearning4j_tpu.perf.bucketing import pad_dataset
+
+        return pad_dataset(self.underlying.next(num), buckets=self.buckets)
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def total_examples(self):
+        return self.underlying.total_examples()
+
+    def input_columns(self):
+        return self.underlying.input_columns()
+
+    def total_outcomes(self):
+        return self.underlying.total_outcomes()
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch wrapper (AsyncDataSetIterator.java:44).
 

@@ -2,42 +2,83 @@
 
 from __future__ import annotations
 
-from collections import defaultdict
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
 
 class ConfusionMatrix:
-    """Counts of (actual, predicted) pairs (eval/ConfusionMatrix.java)."""
+    """Counts of (actual, predicted) pairs (eval/ConfusionMatrix.java).
+
+    Array-backed: one [C, C] int64 grid, so lookups are O(1), row/column
+    totals are O(C), and whole-batch count arrays (numpy bincount or the
+    device confusion matrix readback) fold in as a single vectorized add —
+    the dict-of-dicts walk the reference uses is O(C²) per ``to_array``
+    and O(batch) python-loop ``add`` calls per eval. Classes outside the
+    declared range grow the grid (the dict accepted them silently)."""
 
     def __init__(self, classes: Sequence[int]):
         self.classes = list(classes)
-        self.matrix: Dict[int, Dict[int, int]] = defaultdict(lambda: defaultdict(int))
+        n = len(self.classes)
+        self._counts = np.zeros((n, n), np.int64)
+
+    def _ensure_size(self, idx: int):
+        n = self._counts.shape[0]
+        if idx < n:
+            return
+        grown = np.zeros((idx + 1, idx + 1), np.int64)
+        grown[:n, :n] = self._counts
+        self._counts = grown
+        self.classes.extend(range(n, idx + 1))
 
     def add(self, actual: int, predicted: int, count: int = 1):
-        self.matrix[int(actual)][int(predicted)] += count
+        a, p = int(actual), int(predicted)
+        self._ensure_size(max(a, p))
+        self._counts[a, p] += count
+
+    def add_array(self, counts: np.ndarray):
+        """Fold a [C', C'] count grid in (vectorized ``add``)."""
+        counts = np.asarray(counts, np.int64)
+        self._ensure_size(counts.shape[0] - 1)
+        self._counts[:counts.shape[0], :counts.shape[1]] += counts
 
     def get_count(self, actual: int, predicted: int) -> int:
-        return self.matrix[int(actual)][int(predicted)]
+        a, p = int(actual), int(predicted)
+        if a >= self._counts.shape[0] or p >= self._counts.shape[1]:
+            return 0
+        return int(self._counts[a, p])
 
     def actual_total(self, actual: int) -> int:
-        return sum(self.matrix[int(actual)].values())
+        a = int(actual)
+        if a >= self._counts.shape[0]:
+            return 0
+        return int(self._counts[a].sum())
 
     def predicted_total(self, predicted: int) -> int:
-        return sum(row[int(predicted)] for row in self.matrix.values())
+        p = int(predicted)
+        if p >= self._counts.shape[1]:
+            return 0
+        return int(self._counts[:, p].sum())
+
+    @property
+    def matrix(self):
+        """Dict-of-dicts view of the nonzero counts — the seed's internal
+        representation, kept read-only for callers that iterate it."""
+        out: dict = {}
+        for a, p in zip(*np.nonzero(self._counts)):
+            out.setdefault(int(a), {})[int(p)] = int(self._counts[a, p])
+        return out
 
     def merge(self, other: "ConfusionMatrix"):
-        for a, row in other.matrix.items():
-            for p, c in row.items():
-                self.matrix[a][p] += c
+        self.add_array(other._counts)
 
     def to_array(self) -> np.ndarray:
         n = len(self.classes)
+        if self._counts.shape[0] == n:
+            return self._counts.copy()
         out = np.zeros((n, n), np.int64)
-        for a in range(n):
-            for p in range(n):
-                out[a, p] = self.get_count(a, p)
+        m = min(n, self._counts.shape[0])
+        out[:m, :m] = self._counts[:m, :m]
         return out
 
 
@@ -73,8 +114,23 @@ class Evaluation:
         if mask is not None:
             keep = np.asarray(mask).astype(bool)
             actual, predicted = actual[keep], predicted[keep]
-        for a, p in zip(actual, predicted):
-            self.confusion.add(a, p)
+        # one bincount over actual*C + predicted replaces the per-example
+        # python loop; C covers any out-of-range class so the flat index
+        # stays collision-free (add_array grows the grid to match)
+        c = max(int(self.num_classes),
+                int(actual.max()) + 1 if actual.size else 0,
+                int(predicted.max()) + 1 if predicted.size else 0)
+        flat = actual.astype(np.int64) * c + predicted.astype(np.int64)
+        counts = np.bincount(flat, minlength=c * c).reshape(c, c)
+        self.confusion.add_array(counts)
+
+    def eval_confusion(self, counts):
+        """Fold a precomputed [C, C] count grid (rows=actual) into this
+        Evaluation — the fold-in point for the DEVICE confusion matrix
+        read back once per ``evaluate()`` call (perf/device_eval)."""
+        counts = np.asarray(counts)
+        self._ensure(counts.shape[0])
+        self.confusion.add_array(counts)
 
     # --- per-class counts ---
     def true_positives(self, cls: int) -> int:

@@ -49,6 +49,12 @@ from deeplearning4j_tpu.nn.updater import (
     lr_policy_scale,
 )
 from deeplearning4j_tpu.ops.losses import compute_loss
+from deeplearning4j_tpu.perf.bucketing import (
+    bucket_size,
+    pad_axis0,
+    padded_label_mask,
+)
+from deeplearning4j_tpu.perf.device_eval import confusion_update
 
 
 def _slice_mds_time(mds: MultiDataSet, start: int, end: int) -> MultiDataSet:
@@ -88,6 +94,8 @@ class ComputationGraph:
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
         self._rnn_state: Dict[str, Any] = {}  # rnnTimeStep carries
+        self._eval_readbacks = 0  # host transfers made by evaluate() calls
+        self._eval_steps: Dict[int, Any] = {}  # jitted eval per output head
 
     @property
     def score_value(self) -> float:
@@ -542,10 +550,27 @@ class ComputationGraph:
         return state or None
 
     # ------------------------------------------------------------------
+    def _batch_bucketable(self) -> bool:
+        """Stack/Unstack vertices split or concatenate ALONG the batch
+        axis — padding the batch would change their segmentation — so
+        bucketing is disabled for graphs containing them (those graphs
+        compile per exact shape, the pre-bucketing behavior)."""
+        return not any(isinstance(v, (StackVertex, UnstackVertex))
+                       for v in self.conf.vertices.values())
+
     def output(self, *inputs) -> List[jnp.ndarray]:
         self._ensure_init()
-        return self._output_fn(self.params, self.net_state,
-                               tuple(jnp.asarray(x) for x in inputs))
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        if not xs or not self._batch_bucketable() or any(
+                x.ndim < 2 for x in xs):
+            return self._output_fn(self.params, self.net_state, xs)
+        n = xs[0].shape[0]
+        b = bucket_size(n)
+        outs = self._output_fn(self.params, self.net_state,
+                               tuple(pad_axis0(x, b) for x in xs))
+        if b == n:
+            return outs
+        return [o[:n] for o in outs]
 
     def feed_forward(self, *inputs) -> Dict[str, jnp.ndarray]:
         self._ensure_init()
@@ -594,41 +619,120 @@ class ComputationGraph:
             outs = [o[:, 0, :] if o.ndim == 3 else o for o in outs]
         return outs
 
+    @functools.cached_property
+    def _score_fn(self):
+        """Jitted whole-DAG scoring forward (was eager op-by-op dispatch;
+        bucketed callers compile once per shape bucket)."""
+
+        def score(params, net_state, inputs, labels, fms, lms):
+            with dtypes_mod.policy_scope(self._policy):
+                loss, _ = self._loss_and_state(
+                    params, net_state, inputs, labels, fms, lms,
+                    rng=None, train=False)
+            return loss
+
+        return jax.jit(score)
+
     def score(self, mds) -> float:
         self._ensure_init()
         if isinstance(mds, DataSet):
             mds = MultiDataSet.from_dataset(mds)
-        with dtypes_mod.policy_scope(self._policy):
-            loss, _ = self._loss_and_state(
-                self.params, self.net_state,
-                tuple(jnp.asarray(f) for f in mds.features),
-                tuple(jnp.asarray(l) for l in mds.labels),
-                None if mds.features_masks is None else tuple(
-                    None if m is None else jnp.asarray(m) for m in mds.features_masks),
-                None if mds.labels_masks is None else tuple(
-                    None if m is None else jnp.asarray(m) for m in mds.labels_masks),
-                rng=None, train=False)
-        self._score = loss
+        inputs = tuple(jnp.asarray(f) for f in mds.features)
+        labels = tuple(jnp.asarray(l) for l in mds.labels)
+        fms = (None if mds.features_masks is None else tuple(
+            None if m is None else jnp.asarray(m)
+            for m in mds.features_masks))
+        raw_lms = (mds.labels_masks if mds.labels_masks is not None
+                   else [None] * len(labels))
+        if self._batch_bucketable() and inputs and not any(
+                x.ndim < 2 for x in inputs):
+            b = bucket_size(inputs[0].shape[0])
+            # per-head label masks always materialized: pad rows drop out
+            # of every head's mask-weighted loss, one program per bucket
+            lms = tuple(padded_label_mask(l, m, b)
+                        for l, m in zip(labels, raw_lms))
+            inputs = tuple(pad_axis0(x, b) for x in inputs)
+            labels = tuple(pad_axis0(l, b) for l in labels)
+            fms = (None if fms is None else
+                   tuple(None if m is None else pad_axis0(m, b)
+                         for m in fms))
+        else:
+            lms = tuple(None if m is None else jnp.asarray(m)
+                        for m in raw_lms)
+            if all(m is None for m in lms):
+                lms = None
+        self._score = self._score_fn(self.params, self.net_state, inputs,
+                                     labels, fms, lms)
         return self.score_value
 
-    def evaluate(self, iterator_or_ds, output_index: int = 0):
+    def _eval_step_for(self, output_index: int):
+        """Jitted device-eval kernel for one output head (cached per
+        head): forward over the DAG + masked argmax + scatter-add into
+        the HBM-resident confusion matrix — the same accumulation path
+        as MultiLayerNetwork._eval_step, no logit round-trip."""
+        fn = self._eval_steps.get(output_index)
+        if fn is None:
+            def step(params, net_state, cm, inputs, y, lm):
+                with dtypes_mod.policy_scope(self._policy):
+                    outs, _, _ = self._forward(params, net_state, inputs,
+                                               train=False, rng=None)
+                return confusion_update(cm, outs[output_index], y, lm)
+
+            fn = jax.jit(step)
+            self._eval_steps[output_index] = fn
+        return fn
+
+    def evaluate(self, iterator_or_ds, output_index: int = 0,
+                 device_accumulation: bool = True):
+        """Classification metrics for one output head. Default path
+        accumulates the confusion matrix ON DEVICE across all batches
+        (one [C, C] readback per call — see MultiLayerNetwork.evaluate);
+        batches pad to shape buckets unless the graph has batch-coupled
+        Stack/Unstack vertices. ``device_accumulation=False`` keeps the
+        per-batch logit-readback host path."""
         from deeplearning4j_tpu.eval import Evaluation
 
+        self._ensure_init()
         ev = Evaluation()
         batches = iterator_or_ds
         if isinstance(batches, (DataSet, MultiDataSet)):
             batches = [batches]
         elif hasattr(batches, "reset"):
             batches.reset()
+        if not device_accumulation:
+            for ds in batches:
+                if isinstance(ds, DataSet):
+                    ds = MultiDataSet.from_dataset(ds)
+                outs = self.output(*ds.features)
+                lm = None
+                if (ds.labels_masks is not None
+                        and ds.labels_masks[output_index] is not None):
+                    lm = np.asarray(ds.labels_masks[output_index])
+                ev.eval(np.asarray(ds.labels[output_index]),
+                        np.asarray(outs[output_index]), mask=lm)
+            return ev
+        step = self._eval_step_for(output_index)
+        bucketable = self._batch_bucketable()
+        cm = None
         for ds in batches:
             if isinstance(ds, DataSet):
                 ds = MultiDataSet.from_dataset(ds)
-            outs = self.output(*ds.features)
-            lm = None
-            if ds.labels_masks is not None and ds.labels_masks[output_index] is not None:
-                lm = np.asarray(ds.labels_masks[output_index])
-            ev.eval(np.asarray(ds.labels[output_index]),
-                    np.asarray(outs[output_index]), mask=lm)
+            xs = tuple(jnp.asarray(f) for f in ds.features)
+            y = jnp.asarray(ds.labels[output_index])
+            raw_lm = (None if ds.labels_masks is None
+                      else ds.labels_masks[output_index])
+            n = xs[0].shape[0] if xs else y.shape[0]
+            b = bucket_size(n) if bucketable and not any(
+                x.ndim < 2 for x in xs) else n
+            lm = padded_label_mask(y, raw_lm, b)
+            if cm is None:
+                cm = jnp.zeros((int(y.shape[-1]),) * 2, jnp.int32)
+            cm = step(self.params, self.net_state, cm,
+                      tuple(pad_axis0(x, b) for x in xs),
+                      pad_axis0(y, b), lm)
+        if cm is not None:
+            self._eval_readbacks += 1
+            ev.eval_confusion(np.asarray(cm))  # the one host transfer
         return ev
 
     def num_params(self) -> int:

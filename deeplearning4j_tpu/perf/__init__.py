@@ -1,0 +1,29 @@
+"""Inference/eval hot-path machinery.
+
+Two pieces, shared by the whole scoring surface
+(``output``/``predict``/``score``/``evaluate`` on both network classes):
+
+- ``bucketing`` — shape-bucketed padding of ragged batches so each jitted
+  scoring program compiles once per bucket instead of once per batch shape
+  (generalizes the ``nlp/trees.pad_to_bucket`` idea to DataSet batches,
+  with mask-correct handling of pad rows).
+- ``device_eval`` — on-device metric accumulation: masked argmax +
+  scatter-add into a ``[C, C]`` confusion matrix (and per-column sums for
+  regression stats) that live in HBM across batches, so ``evaluate()``
+  reads back one small array per call instead of per-batch logits.
+"""
+
+from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
+    DEFAULT_BATCH_BUCKETS,
+    bucket_size,
+    bucketing_enabled,
+    pad_axis0,
+    pad_dataset,
+    padded_label_mask,
+)
+from deeplearning4j_tpu.perf.device_eval import (  # noqa: F401
+    RegressionStats,
+    confusion_update,
+    init_regression_sums,
+    regression_update,
+)

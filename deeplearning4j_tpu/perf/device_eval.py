@@ -1,0 +1,151 @@
+"""On-device metric accumulation for ``evaluate()``.
+
+The host-side evaluation path transfers full ``[B, C]`` logits per batch
+through a 37 MB/s link (PERF.md) just to argmax them and bump integer
+counters. These kernels keep the reduction where the logits already are:
+a ``[C, C]`` confusion matrix (int32) and per-column regression sums live
+in HBM across the whole iterator, updated by a jitted masked-argmax +
+scatter-add per batch, and ``evaluate()`` reads back ONE small array per
+call. The GSPMD/TF-systems lesson applied to scoring: move the reduction
+to the data, amortize the dispatch, transfer only the result.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _flatten_time(output, labels, mask):
+    """[b, t, c] -> [b*t, c] (mask [b, t] -> [b*t]), matching the host
+    ``Evaluation.eval`` time-into-batch flattening."""
+    if output.ndim == 3:
+        b, t, c = output.shape
+        output = output.reshape(b * t, c)
+        labels = labels.reshape(b * t, c)
+        if mask is not None:
+            mask = mask.reshape(b * t)
+    return output, labels, mask
+
+
+def confusion_update(cm, output, labels, mask=None):
+    """One batch folded into the device confusion matrix.
+
+    ``cm``: [C, C] int array (rows=actual, cols=predicted). ``output`` /
+    ``labels``: [b, c] or [b, t, c]; ``mask``: [b] / [b, t], nonzero=keep
+    (pad rows and masked RNN timesteps carry 0 and add nothing — their
+    argmax lands in the matrix with weight 0). Trace-compatible: jit this
+    (inside the network eval step) and the accumulation never leaves HBM.
+    """
+    output, labels, mask = _flatten_time(output, labels, mask)
+    predicted = jnp.argmax(output, axis=-1)
+    actual = jnp.argmax(labels, axis=-1)
+    if mask is None:
+        w = jnp.ones(predicted.shape, cm.dtype)
+    else:
+        w = (mask != 0).astype(cm.dtype)
+    return cm.at[actual, predicted].add(w)
+
+
+# ---------------------------------------------------------------------------
+# regression: per-column sufficient statistics in Welford/Chan form —
+# {n, mean, M2 (centered second moment), C (centered co-moment)} plus the
+# error sums Σ|y-p| and Σ(y-p)². MSE/MAE/RMSE/R²/Pearson all derive from
+# these 1+7·C floats, so the device ships a few hundred bytes per evaluate
+# instead of every prediction like RegressionEvaluation's stacked-array
+# path. Centered accumulation, NOT raw Σy²: the E[y²]-E[y]² form loses all
+# significance in f32 once |mean| >> std (TPUs have no f64), while Chan's
+# pairwise merge stays stable.
+# ---------------------------------------------------------------------------
+
+
+def init_regression_sums(num_columns: int) -> Dict[str, jnp.ndarray]:
+    z = lambda: jnp.zeros((num_columns,), jnp.float32)
+    return {"n": jnp.zeros((), jnp.float32),
+            "mean_y": z(), "mean_p": z(), "m2_y": z(), "m2_p": z(),
+            "c_yp": z(), "sum_abs": z(), "sum_sq": z()}
+
+
+def regression_update(sums, output, labels, mask=None):
+    output, labels, mask = _flatten_time(output, labels, mask)
+    y = labels.astype(jnp.float32)
+    p = output.astype(jnp.float32)
+    if mask is None:
+        w = jnp.ones((y.shape[0],), jnp.float32)
+    else:
+        w = (mask != 0).astype(jnp.float32)
+    wc = w[:, None]
+    # this batch's centered stats (one pass, weighted)
+    nb = jnp.sum(w)
+    safe_nb = jnp.maximum(nb, 1.0)
+    mean_yb = jnp.sum(y * wc, axis=0) / safe_nb
+    mean_pb = jnp.sum(p * wc, axis=0) / safe_nb
+    dy, dp = y - mean_yb, p - mean_pb
+    m2_yb = jnp.sum(dy * dy * wc, axis=0)
+    m2_pb = jnp.sum(dp * dp * wc, axis=0)
+    c_b = jnp.sum(dy * dp * wc, axis=0)
+    # Chan parallel merge with the running stats
+    na, ntot = sums["n"], sums["n"] + nb
+    safe_n = jnp.maximum(ntot, 1.0)
+    delta_y = mean_yb - sums["mean_y"]
+    delta_p = mean_pb - sums["mean_p"]
+    factor = na * nb / safe_n
+    err = y - p
+    return {
+        "n": ntot,
+        "mean_y": sums["mean_y"] + delta_y * nb / safe_n,
+        "mean_p": sums["mean_p"] + delta_p * nb / safe_n,
+        "m2_y": sums["m2_y"] + m2_yb + delta_y * delta_y * factor,
+        "m2_p": sums["m2_p"] + m2_pb + delta_p * delta_p * factor,
+        "c_yp": sums["c_yp"] + c_b + delta_y * delta_p * factor,
+        "sum_abs": sums["sum_abs"] + jnp.sum(jnp.abs(err) * wc, axis=0),
+        "sum_sq": sums["sum_sq"] + jnp.sum(err * err * wc, axis=0),
+    }
+
+
+class RegressionStats:
+    """Host-side view over the device sums; same accessor surface as
+    ``RegressionEvaluation`` (per-column MSE/MAE/RMSE/R²/Pearson)."""
+
+    def __init__(self, sums):
+        self._s = {k: np.asarray(v, np.float64) for k, v in sums.items()}
+        self.num_columns = int(self._s["mean_y"].shape[0])
+
+    @property
+    def n(self) -> float:
+        return float(self._s["n"])
+
+    def mean_squared_error(self, col: int) -> float:
+        return float(self._s["sum_sq"][col] / self.n)
+
+    def mean_absolute_error(self, col: int) -> float:
+        return float(self._s["sum_abs"][col] / self.n)
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def correlation_r2(self, col: int) -> float:
+        ss_tot = self._s["m2_y"][col]  # == Σ(y - ȳ)² exactly
+        if ss_tot == 0:
+            return 0.0
+        return float(1.0 - self._s["sum_sq"][col] / ss_tot)
+
+    def pearson_correlation(self, col: int) -> float:
+        s = self._s
+        var_y, var_p = s["m2_y"][col], s["m2_p"][col]
+        if var_y <= 0 or var_p <= 0:
+            return 0.0
+        return float(s["c_yp"][col] / np.sqrt(var_y * var_p))
+
+    def stats(self) -> str:
+        lines = ["Column    MSE        MAE        RMSE       R^2        Corr"]
+        for c in range(self.num_columns):
+            lines.append(
+                f"{c:6d} {self.mean_squared_error(c):10.5f} "
+                f"{self.mean_absolute_error(c):10.5f} "
+                f"{self.root_mean_squared_error(c):10.5f} "
+                f"{self.correlation_r2(c):10.5f} "
+                f"{self.pearson_correlation(c):10.5f}")
+        return "\n".join(lines)

@@ -3,12 +3,24 @@
 # Usage: scripts/verify.sh            (full tier-1: everything not 'slow')
 #        scripts/verify.sh -m chaos   (extra pytest args narrow the run,
 #                                      e.g. just the fault-injection suite)
+#        scripts/verify.sh --eval     (just the eval/inference equivalence
+#                                      suite: device-vs-host metrics,
+#                                      recompile guard, bucketing)
+# The eval equivalence tests (tests/test_eval_device.py) are part of the
+# default tier-1 run; --eval is the narrow fast path for iterating on the
+# scoring surface.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
+TARGET=tests/
+if [ "${1:-}" = "--eval" ]; then
+    shift
+    TARGET=tests/test_eval_device.py
+fi
+
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest "$TARGET" -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee /tmp/_t1.log

@@ -1,0 +1,505 @@
+"""Device-resident evaluation + shape-bucketed inference path.
+
+Covers the eval/inference acceptance criteria:
+- host-side vectorized Evaluation.eval is byte-identical to the reference
+  per-example loop (bincount vs dict-of-dicts)
+- device-accumulated evaluate() == host-path evaluate() on every metric,
+  with and without label masks, FF and RNN
+- recompile guard: a ragged-tail batch stream compiles exactly one program
+  per shape bucket for output/evaluate
+- one-transfer-per-evaluate invariant (the [C, C] readback)
+- device argmax predict(), ComputationGraph shared path, regression sums,
+  BucketedDataSetIterator
+"""
+
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    BucketedDataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.eval.evaluation import (
+    ConfusionMatrix,
+    Evaluation,
+    RegressionEvaluation,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.perf.bucketing import (
+    bucket_size,
+    pad_axis0,
+    pad_dataset,
+    padded_label_mask,
+)
+
+
+def mlp_net(d=8, classes=3, seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(0.1).updater(Updater.SGD)
+        .list()
+        .layer(0, L.DenseLayer(n_in=d, n_out=16, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=16, n_out=classes,
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def rnn_net(f=6, classes=4, seed=3):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed).learning_rate(0.1).updater(Updater.SGD)
+        .list()
+        .layer(0, L.GravesLSTM(n_in=f, n_out=12, activation="tanh"))
+        .layer(1, L.RnnOutputLayer(n_in=12, n_out=classes,
+                                   loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def classification_batches(rng, sizes, d=8, classes=3):
+    out = []
+    for n in sizes:
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def reference_loop_eval(labels, predictions, mask=None, num_classes=None):
+    """The seed's per-example dict-of-dicts implementation, verbatim
+    semantics — the byte-identity oracle for the vectorized host path."""
+    labels = np.asarray(labels)
+    predictions = np.asarray(predictions)
+    if labels.ndim == 3:
+        b, t, c = labels.shape
+        labels = labels.reshape(b * t, c)
+        predictions = predictions.reshape(b * t, c)
+        if mask is not None:
+            mask = np.asarray(mask).reshape(b * t)
+    n = num_classes or labels.shape[-1]
+    actual = np.argmax(labels, axis=-1)
+    predicted = np.argmax(predictions, axis=-1)
+    if mask is not None:
+        keep = np.asarray(mask).astype(bool)
+        actual, predicted = actual[keep], predicted[keep]
+    matrix = defaultdict(lambda: defaultdict(int))
+    for a, p in zip(actual, predicted):
+        matrix[int(a)][int(p)] += 1
+    out = np.zeros((n, n), np.int64)
+    for a in range(n):
+        for p in range(n):
+            out[a, p] = matrix[a][p]
+    return out
+
+
+class TestVectorizedHostEval:
+    def test_byte_identical_2d(self, rng):
+        y = np.eye(5)[rng.integers(0, 5, 333)]
+        p = rng.random((333, 5))
+        ev = Evaluation()
+        ev.eval(y, p)
+        np.testing.assert_array_equal(ev.confusion.to_array(),
+                                      reference_loop_eval(y, p))
+
+    def test_byte_identical_3d_masked(self, rng):
+        y = np.eye(4)[rng.integers(0, 4, (16, 9))]
+        p = rng.random((16, 9, 4))
+        mask = rng.integers(0, 2, (16, 9)).astype(np.float32)
+        ev = Evaluation()
+        ev.eval(y, p, mask=mask)
+        np.testing.assert_array_equal(ev.confusion.to_array(),
+                                      reference_loop_eval(y, p, mask=mask))
+
+    def test_byte_identical_incremental(self, rng):
+        """Multiple eval() calls accumulate identically to one loop pass."""
+        ev = Evaluation()
+        ref = np.zeros((3, 3), np.int64)
+        for _ in range(4):
+            y = np.eye(3)[rng.integers(0, 3, 50)]
+            p = rng.random((50, 3))
+            ev.eval(y, p)
+            ref += reference_loop_eval(y, p)
+        np.testing.assert_array_equal(ev.confusion.to_array(), ref)
+
+    def test_empty_after_mask(self):
+        ev = Evaluation()
+        y = np.eye(3)[[0, 1]]
+        p = np.eye(3)[[0, 1]]
+        ev.eval(y, p, mask=np.zeros(2))
+        assert ev.confusion.to_array().sum() == 0
+        assert ev.accuracy() == 0.0
+
+    def test_metrics_unchanged(self, rng):
+        y = np.eye(4)[rng.integers(0, 4, 200)]
+        p = rng.random((200, 4))
+        ev = Evaluation()
+        ev.eval(y, p)
+        arr = reference_loop_eval(y, p)
+        total, correct = arr.sum(), np.trace(arr)
+        assert ev.accuracy() == pytest.approx(correct / total)
+        for c in range(4):
+            tp = arr[c, c]
+            assert ev.true_positives(c) == tp
+            assert ev.false_positives(c) == arr[:, c].sum() - tp
+            assert ev.false_negatives(c) == arr[c].sum() - tp
+
+
+class TestConfusionMatrix:
+    def test_add_get_totals(self):
+        cm = ConfusionMatrix([0, 1, 2])
+        cm.add(0, 1)
+        cm.add(0, 1)
+        cm.add(2, 0, count=3)
+        assert cm.get_count(0, 1) == 2
+        assert cm.actual_total(0) == 2
+        assert cm.predicted_total(0) == 3
+        assert cm.predicted_total(1) == 2
+        assert cm.get_count(1, 1) == 0
+
+    def test_merge(self):
+        a, b = ConfusionMatrix([0, 1]), ConfusionMatrix([0, 1])
+        a.add(0, 0)
+        b.add(0, 0)
+        b.add(1, 0)
+        a.merge(b)
+        np.testing.assert_array_equal(a.to_array(), [[2, 0], [1, 0]])
+
+    def test_out_of_range_grows(self):
+        cm = ConfusionMatrix([0, 1])
+        cm.add(4, 1)
+        assert cm.get_count(4, 1) == 1
+        assert cm.actual_total(4) == 1
+        assert cm.to_array().shape == (5, 5)
+        assert cm.get_count(9, 9) == 0  # read past the grid is 0, no grow
+
+
+class TestBucketing:
+    def test_ladder(self):
+        assert bucket_size(1) == 1
+        assert bucket_size(3) == 4
+        assert bucket_size(64) == 64
+        assert bucket_size(65) == 128
+        assert bucket_size(5000) == 8192  # beyond ladder: multiple of top
+
+    def test_disable_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_DISABLE_BUCKETING", "1")
+        assert bucket_size(3) == 3
+
+    def test_pad_axis0(self):
+        a = np.arange(6, dtype=np.float32).reshape(3, 2)
+        p = pad_axis0(a, 5)
+        assert p.shape == (5, 2)
+        np.testing.assert_array_equal(p[:3], a)
+        np.testing.assert_array_equal(p[3:], 0)
+        assert pad_axis0(a, 3) is a
+        assert pad_axis0(None, 5) is None
+
+    def test_padded_label_mask_created_and_extended(self):
+        import jax.numpy as jnp
+
+        y2 = jnp.ones((3, 4))
+        m = padded_label_mask(y2, None, 8)
+        assert m.shape == (8,)
+        np.testing.assert_array_equal(np.asarray(m), [1] * 3 + [0] * 5)
+        y3 = jnp.ones((2, 5, 4))
+        m3 = padded_label_mask(y3, np.array([[1, 1, 0, 0, 0],
+                                             [1, 1, 1, 1, 0]]), 4)
+        assert m3.shape == (4, 5)
+        assert np.asarray(m3)[2:].sum() == 0
+        assert np.asarray(m3)[:2].sum() == 6
+
+    def test_pad_dataset_always_has_labels_mask(self):
+        ds = DataSet(np.ones((5, 3), np.float32), np.ones((5, 2), np.float32))
+        p = pad_dataset(ds)
+        assert p.features.shape == (8, 3)
+        assert p.labels.shape == (8, 2)
+        assert p.labels_mask is not None
+        np.testing.assert_array_equal(np.asarray(p.labels_mask),
+                                      [1] * 5 + [0] * 3)
+        # exact-bucket batch STILL gets the mask (one jit signature/bucket)
+        full = pad_dataset(DataSet(np.ones((8, 3), np.float32),
+                                   np.ones((8, 2), np.float32)))
+        assert full.labels_mask is not None
+        assert np.asarray(full.labels_mask).sum() == 8
+
+
+class TestDeviceEvalEquivalence:
+    def test_mlp_device_matches_host(self, rng):
+        net = mlp_net()
+        batches = classification_batches(rng, [64, 64, 37])
+        dev = net.evaluate(batches)
+        host = net.evaluate(batches, device_accumulation=False)
+        np.testing.assert_array_equal(dev.confusion.to_array(),
+                                      host.confusion.to_array())
+        for metric in ("accuracy", "precision", "recall", "f1"):
+            assert getattr(dev, metric)() == pytest.approx(
+                getattr(host, metric)()), metric
+
+    def test_rnn_masked_device_matches_host(self, rng):
+        net = rnn_net()
+        batches = []
+        for n in (16, 16, 9):
+            x = rng.normal(size=(n, 7, 6)).astype(np.float32)
+            y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (n, 7))]
+            lengths = rng.integers(3, 8, n)
+            lm = (np.arange(7)[None, :] < lengths[:, None]).astype(np.float32)
+            batches.append(DataSet(x, y, labels_mask=lm))
+        dev = net.evaluate(batches)
+        host = net.evaluate(batches, device_accumulation=False)
+        np.testing.assert_array_equal(dev.confusion.to_array(),
+                                      host.confusion.to_array())
+        assert dev.f1() == pytest.approx(host.f1())
+
+    def test_rnn_unmasked_device_matches_host(self, rng):
+        net = rnn_net()
+        x = rng.normal(size=(11, 5, 6)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (11, 5))]
+        ds = DataSet(x, y)
+        np.testing.assert_array_equal(
+            net.evaluate(ds).confusion.to_array(),
+            net.evaluate(ds, device_accumulation=False).confusion.to_array())
+
+    def test_single_dataset_and_iterator_agree(self, rng):
+        net = mlp_net()
+        merged = DataSet.merge(classification_batches(rng, [100]))
+        it = ListDataSetIterator(merged, batch_size=33)  # 33/33/33/1 tails
+        np.testing.assert_array_equal(
+            net.evaluate(it).confusion.to_array(),
+            net.evaluate(merged).confusion.to_array())
+
+
+class TestOneTransferInvariant:
+    def test_one_device_to_host_conversion_measured(self, rng, monkeypatch):
+        """Independent measurement, not the code's own counter: wrap
+        numpy.asarray and count calls that receive a DEVICE array (each
+        one is a device→host transfer). A whole multi-batch evaluate()
+        must make exactly one — the [C, C] confusion readback."""
+        import jax
+
+        net = mlp_net()
+        batches = classification_batches(rng, [32, 32, 32, 17])
+        net.evaluate(batches)  # compile outside the measured window
+        transfers = []
+        real_asarray = np.asarray
+
+        def counting_asarray(a, *args, **kwargs):
+            if isinstance(a, jax.Array):
+                transfers.append(a.shape)
+            return real_asarray(a, *args, **kwargs)
+
+        monkeypatch.setattr(np, "asarray", counting_asarray)
+        try:
+            ev = net.evaluate(batches)
+        finally:
+            monkeypatch.undo()
+        assert transfers == [(3, 3)], transfers  # ONLY the [C, C] readback
+        assert ev.confusion.to_array().sum() == 113
+
+    def test_readback_counter_tracks_calls(self, rng):
+        net = mlp_net()
+        batches = classification_batches(rng, [32, 32, 32, 17])
+        assert net._eval_readbacks == 0
+        net.evaluate(batches)
+        assert net._eval_readbacks == 1
+        net.evaluate(batches)
+        assert net._eval_readbacks == 2
+
+    def test_empty_iterator_no_transfer(self):
+        net = mlp_net()
+        ev = net.evaluate([])
+        assert net._eval_readbacks == 0
+        assert ev.confusion is None
+
+
+class TestRecompileGuard:
+    """Count jit cache misses across ragged-tail batch streams: EXACTLY
+    one compile per shape bucket for evaluate/output (acceptance
+    criterion). Sizes 64/64/37/50 share buckets {64}, 100 adds {128}."""
+
+    SIZES = [64, 64, 37, 50, 100]  # buckets: 64, 64, 64, 64, 128
+
+    def test_evaluate_compiles_once_per_bucket(self, rng):
+        net = mlp_net()
+        batches = classification_batches(rng, self.SIZES)
+        net.evaluate(batches)
+        assert net._eval_step._cache_size() == 2
+        # a second pass over the same stream: zero new compiles
+        net.evaluate(batches)
+        assert net._eval_step._cache_size() == 2
+
+    def test_output_compiles_once_per_bucket(self, rng):
+        net = mlp_net()
+        for ds in classification_batches(rng, self.SIZES):
+            net.output(ds.features)
+        assert net._output_fn._cache_size() == 2
+
+    def test_predict_compiles_once_per_bucket(self, rng):
+        net = mlp_net()
+        for ds in classification_batches(rng, self.SIZES):
+            net.predict(ds.features)
+        assert net._predict_fn._cache_size() == 2
+
+    def test_score_compiles_once_per_bucket(self, rng):
+        net = mlp_net()
+        for ds in classification_batches(rng, self.SIZES):
+            net.score(ds)
+        assert net._score_fn._cache_size() == 2
+
+
+class TestOutputAndPredict:
+    def test_output_values_unchanged_by_padding(self, rng):
+        """Pad rows must not leak into real rows: bucketed output ==
+        exact-shape output (row-independent forward)."""
+        net = mlp_net()
+        x = rng.normal(size=(37, 8)).astype(np.float32)
+        bucketed = np.asarray(net.output(x))
+        import os
+
+        os.environ["DL4J_DISABLE_BUCKETING"] = "1"
+        try:
+            exact = np.asarray(net.output(x))
+        finally:
+            del os.environ["DL4J_DISABLE_BUCKETING"]
+        assert bucketed.shape == (37, 3)
+        np.testing.assert_allclose(bucketed, exact, rtol=1e-6, atol=1e-7)
+
+    def test_predict_matches_host_argmax(self, rng):
+        net = mlp_net()
+        x = rng.normal(size=(29, 8)).astype(np.float32)
+        preds = net.predict(x)
+        assert preds.shape == (29,)
+        assert preds.dtype == np.int32
+        np.testing.assert_array_equal(
+            preds, np.argmax(np.asarray(net.output(x)), axis=-1))
+
+    def test_score_value_unchanged_by_padding(self, rng):
+        net = mlp_net()
+        ds = classification_batches(rng, [37])[0]
+        bucketed = net.score(ds)
+        import os
+
+        os.environ["DL4J_DISABLE_BUCKETING"] = "1"
+        try:
+            exact = net.score(ds)
+        finally:
+            del os.environ["DL4J_DISABLE_BUCKETING"]
+        assert bucketed == pytest.approx(exact, rel=1e-5)
+
+
+class TestGraphDeviceEval:
+    @staticmethod
+    def _toy_graph(seed=5):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        g = (
+            NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(0.05).updater(Updater.SGD)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", L.DenseLayer(n_in=6, n_out=8,
+                                         activation="tanh"), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=8, n_out=3, loss_function=LossFunction.MCXENT), "d")
+            .set_outputs("out")
+        )
+        return ComputationGraph(g.build()).init()
+
+    def test_device_matches_host(self, rng):
+        net = self._toy_graph()
+        batches = []
+        for n in (32, 32, 19):
+            x = rng.normal(size=(n, 6)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+            batches.append(DataSet(x, y))
+        dev = net.evaluate(batches)
+        host = net.evaluate(batches, device_accumulation=False)
+        np.testing.assert_array_equal(dev.confusion.to_array(),
+                                      host.confusion.to_array())
+        assert dev.accuracy() == pytest.approx(host.accuracy())
+        assert net._eval_readbacks == 1
+
+    def test_graph_compiles_once_per_bucket(self, rng):
+        net = self._toy_graph()
+        batches = [DataSet(rng.normal(size=(n, 6)).astype(np.float32),
+                           np.eye(3, dtype=np.float32)[
+                               rng.integers(0, 3, n)])
+                   for n in (32, 32, 19, 25)]  # one bucket: 32
+        net.evaluate(batches)
+        assert net._eval_steps[0]._cache_size() == 1
+
+    def test_graph_output_bucketed_values(self, rng):
+        net = self._toy_graph()
+        x = rng.normal(size=(19, 6)).astype(np.float32)
+        out = net.output(x)[0]
+        assert out.shape == (19, 3)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1),
+                                   np.ones(19), rtol=1e-5)
+
+
+class TestRegressionDeviceEval:
+    def _reg_net(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(11).learning_rate(0.05).updater(Updater.SGD)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=2, activation="identity",
+                                    loss_function=LossFunction.MSE))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_sums_match_host_regression_eval(self, rng):
+        net = self._reg_net()
+        batches = [DataSet(rng.normal(size=(n, 4)).astype(np.float32),
+                           rng.normal(size=(n, 2)).astype(np.float32))
+                   for n in (32, 32, 21)]
+        stats = net.evaluate_regression(batches)
+        host = RegressionEvaluation()
+        for ds in batches:
+            host.eval(np.asarray(ds.labels), np.asarray(net.output(ds.features)))
+        for c in range(2):
+            assert stats.mean_squared_error(c) == pytest.approx(
+                host.mean_squared_error(c), rel=1e-4)
+            assert stats.mean_absolute_error(c) == pytest.approx(
+                host.mean_absolute_error(c), rel=1e-4)
+            assert stats.correlation_r2(c) == pytest.approx(
+                host.correlation_r2(c), rel=1e-3, abs=1e-4)
+            assert stats.pearson_correlation(c) == pytest.approx(
+                host.pearson_correlation(c), rel=1e-3, abs=1e-4)
+        assert stats.n == 85
+        assert "MSE" in stats.stats()
+
+
+class TestBucketedIterator:
+    def test_pads_tail_and_masks(self, rng):
+        ds = DataSet.merge(classification_batches(rng, [90]))
+        it = BucketedDataSetIterator(ListDataSetIterator(ds, batch_size=64))
+        batches = list(it)
+        assert [b.features.shape[0] for b in batches] == [64, 32]
+        tail = batches[1]
+        np.testing.assert_array_equal(np.asarray(tail.labels_mask),
+                                      [1] * 26 + [0] * 6)
+        assert it.total_examples() == 90
+
+    def test_training_and_eval_through_bucketed_iterator(self, rng):
+        ds = DataSet.merge(classification_batches(rng, [90]))
+        net = mlp_net()
+        it = BucketedDataSetIterator(ListDataSetIterator(ds, batch_size=64))
+        net.fit(it, num_epochs=2)
+        assert net._train_step._cache_size() <= 2  # 64-bucket + 32-bucket
+        ev = net.evaluate(it)
+        host = net.evaluate(ds, device_accumulation=False)
+        # pad rows are mask-inert: totals match the unpadded dataset
+        assert ev.confusion.to_array().sum() == 90
+        np.testing.assert_array_equal(ev.confusion.to_array(),
+                                      host.confusion.to_array())

@@ -26,6 +26,8 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
   - GEMM sweep 512–8192 (bf16) — dispatch-chained AND fori-loop-fused
     TFLOP/s per size (fused isolates the chip from the dispatch floor)
   - infeed: async device-prefetch overlap vs synchronous feeding
+  - epoch: HBM-cached whole-epoch fusion (fit_epochs) vs streaming
+    per-step fit — samples/sec + measured dispatches-per-epoch
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs are analytic (formula noted per entry in "flops_source").
@@ -326,6 +328,73 @@ def bench_infeed():
             "async_prefetch_samples_per_sec": round(async_sps, 1),
             "overlap_speedup": round(async_sps / sync_sps, 2),
             "batch": batch, "n_batches": n_batches}
+
+
+def bench_epoch():
+    """Epoch pipeline: HBM-cached whole-epoch fusion (fit_epochs) vs the
+    streaming per-step path on the same multi-batch dataset. Reports
+    samples/sec both ways plus MEASURED train-program dispatches per epoch
+    — the fused path must show exactly 1 (chunk = 1 epoch) vs N for
+    streaming, and the fully-fused variant (all epochs in one program)
+    amortizes even that."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models import mnist_mlp
+    from deeplearning4j_tpu.perf.epoch_cache import DeviceDataSetCache
+
+    rng = np.random.default_rng(0)
+    batch, n_batches, epochs = 2048, 16, 5
+    ds = DataSet(rng.random((batch * n_batches, 784), np.float32),
+                 np.eye(10, dtype=np.float32)[
+                     rng.integers(0, 10, batch * n_batches)])
+    total = batch * n_batches
+
+    def run_cached(chunk):
+        net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+        cache = DeviceDataSetCache.build(ListDataSetIterator(ds, batch))
+        assert cache is not None, "bench dataset exceeded DL4J_DEVICE_CACHE_MB"
+        # warm the SAME chunk length as the timed run: the fused program
+        # is keyed on the epoch_keys shape [k, 2], so a chunk=1 warm-up
+        # would leave the k=epochs program to compile inside the timing
+        net.fit_epochs(cache, chunk, chunk_epochs=chunk)
+        _sync(net.params)
+        d0 = net._train_dispatches
+        t0 = time.perf_counter()
+        net.fit_epochs(cache, epochs, chunk_epochs=chunk)
+        _sync(net.params)
+        sec = time.perf_counter() - t0
+        return (total * epochs / sec,
+                (net._train_dispatches - d0) / epochs)
+
+    def run_streaming():
+        net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+        it = ListDataSetIterator(ds, batch)
+        net.fit(it)  # compile
+        _sync(net.params)
+        d0 = net._train_dispatches
+        t0 = time.perf_counter()
+        net.fit(it, num_epochs=epochs)
+        _sync(net.params)
+        sec = time.perf_counter() - t0
+        return (total * epochs / sec,
+                (net._train_dispatches - d0) / epochs)
+
+    stream_sps, stream_dpe = run_streaming()
+    cached_sps, cached_dpe = run_cached(chunk=1)
+    fused_sps, fused_dpe = run_cached(chunk=epochs)
+    _log(f"epoch: {cached_sps:,.0f} samples/sec cached-fused "
+         f"({cached_dpe:.0f} dispatches/epoch), {fused_sps:,.0f} "
+         f"fully-fused ({fused_dpe:.2f}), {stream_sps:,.0f} streaming "
+         f"({stream_dpe:.0f}) — {cached_sps / stream_sps:.2f}x")
+    return {"cached_samples_per_sec": round(cached_sps, 1),
+            "fully_fused_samples_per_sec": round(fused_sps, 1),
+            "streaming_samples_per_sec": round(stream_sps, 1),
+            "speedup": round(cached_sps / stream_sps, 2),
+            "dispatches_per_epoch_cached": round(cached_dpe, 2),
+            "dispatches_per_epoch_fully_fused": round(fused_dpe, 2),
+            "dispatches_per_epoch_streaming": round(stream_dpe, 2),
+            "batch": batch, "n_batches": n_batches, "epochs": epochs,
+            "total_samples": total}
 
 
 def bench_eval():
@@ -719,7 +788,8 @@ def main() -> None:
                 ("word2vec", bench_word2vec),
                 ("resnet18_cifar10", bench_resnet18),
                 ("infeed", bench_infeed),
-                ("eval", bench_eval)]
+                ("eval", bench_eval),
+                ("epoch", bench_epoch)]
     if only:
         known = {n for n, _ in sections} | {"transformer"}
         unknown = sorted(only - known)

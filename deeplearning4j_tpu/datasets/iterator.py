@@ -289,17 +289,20 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 4,
                  device_prefetch: bool = False):
-        """``device_prefetch=True`` adds device-side double-buffering: the
-        producer thread ``jax.device_put``s each batch as it is queued, so
-        the NEXT batch's host→HBM transfer overlaps the RUNNING step
-        (``DataSet`` keeps device arrays as-is — no host gather). This is
-        the TPU-native role of the reference's async prefetch
-        (AsyncDataSetIterator.java:44): there the overlap hid disk ETL;
-        here it also hides the PCIe/ICI infeed."""
+        """``queue_size`` governs BOTH buffers: the host queue depth and —
+        with ``device_prefetch=True`` — how many batches sit in HBM ahead
+        of the consumer, because the producer thread ``jax.device_put``s
+        each batch BEFORE queuing it. A deep buffer (fit_epochs' streaming
+        fallback uses ``DL4J_PREFETCH_DEPTH``, default 8) keeps the
+        host→device link busy across step-time jitter instead of
+        double-buffering at depth 1. (``DataSet`` keeps device arrays
+        as-is — no host gather.) This is the TPU-native role of the
+        reference's async prefetch (AsyncDataSetIterator.java:44): there
+        the overlap hid disk ETL; here it also hides the PCIe/ICI infeed."""
         self.underlying = underlying
-        self.queue_size = queue_size
+        self.queue_size = max(1, int(queue_size))
         self.device_prefetch = device_prefetch
-        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._queue: queue.Queue = queue.Queue(maxsize=self.queue_size)
         self._thread: Optional[threading.Thread] = None
         self._peek = None
         self._started = False
@@ -316,28 +319,39 @@ class AsyncDataSetIterator(DataSetIterator):
                        put(ds.features_mask), put(ds.labels_mask))
 
     def _start(self):
-        self._queue = queue.Queue(maxsize=self.queue_size)
-        self._stop_flag = threading.Event()
-        self._producer_error: Optional[BaseException] = None
-        stop = self._stop_flag
+        # The producer's queue/stop/error state is generation-local
+        # (captured by the closure, not read off self): a straggler thread
+        # from a previous generation can only ever touch its OWN queue and
+        # error slot, never the new generation's. The one genuinely shared
+        # object is ``self.underlying`` — which is why _shutdown refuses to
+        # start a new generation until the old thread has actually exited.
+        q = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
+        state = {"error": None}
+        self._queue = q
+        self._stop_flag = stop
+        self._producer_state = state
+
+        def put_bounded(item) -> bool:
+            """Enqueue honoring the stop flag — the producer must never
+            block indefinitely (a permanently-parked thread is a leak)."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
-                while self.underlying.has_next():
-                    if stop.is_set():
+                while not stop.is_set() and self.underlying.has_next():
+                    if not put_bounded(self._to_device(self.underlying.next())):
                         return
-                    item = self._to_device(self.underlying.next())
-                    while not stop.is_set():
-                        try:
-                            self._queue.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
             except BaseException as exc:  # re-raised on the consumer side
-                self._producer_error = exc
+                state["error"] = exc
             finally:
-                if not stop.is_set():
-                    self._queue.put(self._END)
+                put_bounded(self._END)
 
         self._thread = threading.Thread(target=producer, daemon=True)
         self._thread.start()
@@ -349,8 +363,9 @@ class AsyncDataSetIterator(DataSetIterator):
         if not self._started:
             self._start()
         self._peek = self._queue.get()
-        if self._peek is self._END and self._producer_error is not None:
-            exc, self._producer_error = self._producer_error, None
+        if self._peek is self._END and self._producer_state["error"] is not None:
+            exc = self._producer_state["error"]
+            self._producer_state["error"] = None
             raise exc
         return self._peek is not self._END
 
@@ -361,12 +376,34 @@ class AsyncDataSetIterator(DataSetIterator):
         return item
 
     def reset(self):
-        if self._started and self._thread is not None and self._thread.is_alive():
-            self._stop_flag.set()
-            self._thread.join(timeout=5)
+        self._shutdown()
         self.underlying.reset()
         self._peek = None
         self._started = False
+
+    def _shutdown(self):
+        """Stop + join the producer. Safe mid-epoch: the stop flag bounds
+        every producer wait (including the terminal _END put), and the
+        queue is drained so a blocked put wakes immediately rather than
+        after a timeout tick. If the thread is STILL alive after the join
+        budget it is parked inside ``underlying.next()`` (a stalled fetch),
+        and resetting the shared underlying under it would corrupt the
+        stream — refuse loudly instead of silently losing batches."""
+        if not self._started or self._thread is None:
+            return
+        self._stop_flag.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "AsyncDataSetIterator producer did not stop within 5s "
+                "(blocked inside underlying.next()?) — refusing to reset "
+                "the shared underlying iterator while it is still in use")
+        self._thread = None
 
     def batch(self):
         return self.underlying.batch()

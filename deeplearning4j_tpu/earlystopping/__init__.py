@@ -289,13 +289,46 @@ class EarlyStoppingTrainer:
     """Epoch loop with scoring/saving/termination
     (trainer/BaseEarlyStoppingTrainer.java:99-142). Works for both
     MultiLayerNetwork and ComputationGraph (the reference's
-    EarlyStoppingGraphTrainer is the same loop)."""
+    EarlyStoppingGraphTrainer is the same loop).
+
+    ``fuse_epochs=True`` opts into the device-resident epoch pipeline:
+    the training set is cached in HBM ONCE (``perf.epoch_cache``) and each
+    epoch runs as a single fused XLA program via ``net.fit_epochs`` — one
+    dispatch per epoch instead of one per batch — while this loop keeps
+    its per-epoch decision point (scoring, saving, epoch conditions).
+    Iteration conditions still see every batch: they are checked host-side
+    against the fused chunk's ``[1, N]`` loss history. Configurations the
+    fused path cannot express (non-SGD solvers, TBPTT, pretraining, the
+    score-reactive LR policy) and over-budget datasets fall back to the
+    per-batch loop automatically."""
 
     def __init__(self, config: EarlyStoppingConfiguration, network,
-                 train_iterator):
+                 train_iterator, fuse_epochs: bool = False):
         self.config = config
         self.network = network
         self.train_iterator = train_iterator
+        self.fuse_epochs = fuse_epochs
+
+    def _build_cache(self):
+        """HBM dataset cache for the fused path, or None (per-batch loop).
+        Built once per fit() — NOT once per epoch: re-draining and
+        re-transferring the same data every epoch is exactly the cost the
+        pipeline removes. The network's config predicate gates the build:
+        a configuration the fused program cannot express must not pay the
+        drain + device transfer for a cache it would never use."""
+        if not (self.fuse_epochs and hasattr(self.network, "fit_epochs")):
+            return None
+        supported = getattr(self.network, "fused_epochs_supported", None)
+        if supported is None or not supported():
+            return None
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.perf.epoch_cache import (
+            DeviceDataSetCache, DeviceMultiDataSetCache)
+
+        builder = (DeviceMultiDataSetCache
+                   if isinstance(self.network, ComputationGraph)
+                   else DeviceDataSetCache)
+        return builder.build(self.train_iterator)
 
     def fit(self) -> EarlyStoppingResult:
         conf = self.config
@@ -304,6 +337,7 @@ class EarlyStoppingTrainer:
             c.initialize()
         for c in conf.iter_conditions:
             c.initialize()
+        cache = self._build_cache()
         score_vs_epoch = {}
         best_score, best_epoch = None, -1
         epoch = 0
@@ -313,16 +347,32 @@ class EarlyStoppingTrainer:
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()
             terminated_iter = False
-            for ds in self.train_iterator:
-                net.fit(ds)
-                for c in conf.iter_conditions:
-                    if c.terminate(net.score_value):
-                        reason = EarlyStoppingResult.TerminationReason.ITERATION_TERMINATION
-                        details = str(c)
-                        terminated_iter = True
+            if cache is not None:
+                import numpy as np
+
+                hist = net.fit_epochs(cache, 1, chunk_epochs=1)
+                batch_scores = ([net.score_value] if hist is None else
+                                [float(s) for s in np.asarray(hist).ravel()])
+                for score in batch_scores:
+                    for c in conf.iter_conditions:
+                        if c.terminate(score):
+                            reason = EarlyStoppingResult.TerminationReason.ITERATION_TERMINATION
+                            details = str(c)
+                            terminated_iter = True
+                            break
+                    if terminated_iter:
                         break
-                if terminated_iter:
-                    break
+            else:
+                for ds in self.train_iterator:
+                    net.fit(ds)
+                    for c in conf.iter_conditions:
+                        if c.terminate(net.score_value):
+                            reason = EarlyStoppingResult.TerminationReason.ITERATION_TERMINATION
+                            details = str(c)
+                            terminated_iter = True
+                            break
+                    if terminated_iter:
+                        break
             if terminated_iter:
                 epoch += 1
                 break

@@ -55,6 +55,12 @@ from deeplearning4j_tpu.perf.bucketing import (
     padded_label_mask,
 )
 from deeplearning4j_tpu.perf.device_eval import confusion_update
+from deeplearning4j_tpu.perf.epoch_cache import (
+    DeviceMultiDataSetCache,
+    drive_epoch_chunks,
+    epoch_schedule,
+    stream_epochs,
+)
 
 
 def _slice_mds_time(mds: MultiDataSet, start: int, end: int) -> MultiDataSet:
@@ -96,6 +102,8 @@ class ComputationGraph:
         self._rnn_state: Dict[str, Any] = {}  # rnnTimeStep carries
         self._eval_readbacks = 0  # host transfers made by evaluate() calls
         self._eval_steps: Dict[int, Any] = {}  # jitted eval per output head
+        self._train_dispatches = 0  # train-program launches (bench evidence)
+        self._epoch_steps: Dict[bool, Any] = {}  # fused epoch program per shuffle
 
     @property
     def score_value(self) -> float:
@@ -361,10 +369,105 @@ class ComputationGraph:
                 keys[1:], None,
             ))
         self._score = loss
+        self._train_dispatches += 1
         self.iteration_count += total
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
         return self
+
+    # ------------------------------------------------------------------
+    # whole-epoch fusion (the ComputationGraph counterpart of
+    # MultiLayerNetwork.fit_epochs — see perf/epoch_cache.py)
+    # ------------------------------------------------------------------
+    def _epoch_train_step(self, shuffle: bool):
+        """E epochs x N batches scanned inside ONE donated XLA program over
+        the HBM-resident ``[N, B, ...]`` stacks (tuples per input/output
+        position); per-epoch device-side reshuffle via ``epoch_schedule``.
+        Returns the ``[E, N]`` loss history."""
+        fn = self._epoch_steps.get(shuffle)
+        if fn is not None:
+            return fn
+
+        def run(params, updater_state, net_state, iteration0, xs, ys, fms,
+                lms, epoch_keys):
+            n = xs[0].shape[0]
+
+            def epoch_body(carry, ekey):
+                params, upd, nst, it = carry
+                order, step_keys = epoch_schedule(ekey, n, shuffle)
+
+                def batch_body(c2, inp):
+                    params, upd, nst, it = c2
+                    i, rng = inp
+                    p2, u2, s2, loss, _ = self._step_impl(
+                        params, upd, nst, it,
+                        tuple(x[i] for x in xs), tuple(y[i] for y in ys),
+                        None if fms is None else tuple(m[i] for m in fms),
+                        tuple(m[i] for m in lms), rng, None)
+                    return (p2, u2, s2, it + 1), loss
+
+                (params, upd, nst, it), losses = jax.lax.scan(
+                    batch_body, (params, upd, nst, it), (order, step_keys))
+                return (params, upd, nst, it), losses
+
+            carry0 = (params, updater_state, net_state, iteration0)
+            (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
+            return p, u, s, hist
+
+        fn = jax.jit(run, donate_argnums=(0, 1, 2))
+        self._epoch_steps[shuffle] = fn
+        return fn
+
+    def fused_epochs_supported(self) -> bool:
+        """True when this configuration can run the fused epoch program.
+        ComputationGraph's per-step path has no non-SGD solver or
+        score-reactive LR handling, so the matrix is narrower than
+        MultiLayerNetwork's: TBPTT and ``iterations > 1`` are the only
+        fallbacks."""
+        from deeplearning4j_tpu.nn.conf.enums import BackpropType
+
+        return (self.conf.backprop_type != BackpropType.TRUNCATED_BPTT
+                and max(1, self.conf.global_conf.iterations) == 1)
+
+    def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
+                   chunk_epochs: Optional[int] = None,
+                   cache_mb: Optional[float] = None):
+        """Whole-epoch fused training over a DataSet/MultiDataSet iterator
+        (or a prebuilt ``DeviceMultiDataSetCache``) — same contract as
+        MultiLayerNetwork.fit_epochs: one dispatch per chunk, per-epoch
+        device-side reshuffle, ``[E, N]`` loss history returned (``None``
+        when a fallback ran). Falls back to the per-step loop for TBPTT and
+        ``iterations > 1``; over-budget datasets stream with N-deep async
+        device prefetch."""
+        self._ensure_init()
+        if num_epochs <= 0:
+            return None
+        if not self.fused_epochs_supported():
+            if isinstance(data, DeviceMultiDataSetCache):
+                raise ValueError(
+                    "this configuration needs the per-step fit loop "
+                    "(TBPTT / iterations > 1) — pass the original "
+                    "iterator, not a DeviceMultiDataSetCache")
+            for _ in range(num_epochs):
+                self.fit(data)
+            return None
+        cache = data if isinstance(data, DeviceMultiDataSetCache) else (
+            DeviceMultiDataSetCache.build(data, budget_mb=cache_mb))
+        if cache is None:
+            stream_epochs(self, data, num_epochs)
+            return None
+        step = self._epoch_train_step(shuffle)
+
+        def launch(epoch_keys):
+            (self.params, self.updater_state, self.net_state, hist) = step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                cache.features, cache.labels, cache.features_masks,
+                cache.labels_masks, epoch_keys)
+            return hist
+
+        return drive_epoch_chunks(self, cache, num_epochs, chunk_epochs,
+                                  launch)
 
     @functools.cached_property
     def _output_fn(self):
@@ -411,6 +514,7 @@ class ComputationGraph:
 
     def _one_iteration(self, mds: MultiDataSet, rnn_state):
         """One optimizer step; returns the new rnn carry (or None)."""
+        self._train_dispatches += 1
         self._rng, rng = jax.random.split(self._rng)
         inputs = tuple(jnp.asarray(f) for f in mds.features)
         labels = tuple(jnp.asarray(l) for l in mds.labels)

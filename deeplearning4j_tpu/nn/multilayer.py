@@ -45,6 +45,12 @@ from deeplearning4j_tpu.perf.bucketing import (
     pad_axis0,
     padded_label_mask,
 )
+from deeplearning4j_tpu.perf.epoch_cache import (
+    DeviceDataSetCache,
+    drive_epoch_chunks,
+    epoch_schedule,
+    stream_epochs,
+)
 from deeplearning4j_tpu.perf.device_eval import (
     RegressionStats,
     confusion_update,
@@ -73,6 +79,8 @@ class MultiLayerNetwork:
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
         self._eval_readbacks = 0  # host transfers made by evaluate() calls
+        self._train_dispatches = 0  # train-program launches (bench evidence)
+        self._epoch_steps: Dict[bool, Any] = {}  # fused epoch program per shuffle
 
     @property
     def score_value(self) -> float:
@@ -348,12 +356,132 @@ class MultiLayerNetwork:
         )
         self._score = loss
         self._last_input = ds.features
+        self._train_dispatches += 1
         self.iteration_count += total
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
         return self
 
+    # ------------------------------------------------------------------
+    # whole-epoch fusion: E epochs x N batches as ONE XLA program over an
+    # HBM-resident dataset cache (the epoch-level generalization of
+    # fit_steps' single-batch fusion — see perf/epoch_cache.py)
+    # ------------------------------------------------------------------
+    def _epoch_train_step(self, shuffle: bool):
+        """Jitted program scanning chunk_epochs x n_batches optimizer steps:
+        outer ``lax.scan`` over epoch keys (each epoch derives a device-side
+        ``jax.random.permutation`` batch order + per-batch step keys via
+        ``epoch_schedule``), inner scan gathering batches from the resident
+        ``[N, B, ...]`` stacks. Params/updater/net state are donated; the
+        dataset stacks are NOT (they stay in HBM across chunks). Returns the
+        ``[E, N]`` loss history."""
+        fn = self._epoch_steps.get(shuffle)
+        if fn is not None:
+            return fn
+
+        def run(params, updater_state, net_state, iteration0, lr_scale_host,
+                xs, ys, fms, lms, epoch_keys):
+            n = xs.shape[0]
+
+            def epoch_body(carry, ekey):
+                params, upd, nst, it = carry
+                order, step_keys = epoch_schedule(ekey, n, shuffle)
+
+                def batch_body(c2, inp):
+                    params, upd, nst, it = c2
+                    i, rng = inp
+                    p2, u2, s2, _, loss = self._step_impl(
+                        params, upd, nst, it, lr_scale_host,
+                        xs[i], ys[i],
+                        None if fms is None else fms[i], lms[i],
+                        rng, None)
+                    return (p2, u2, s2, it + 1), loss
+
+                (params, upd, nst, it), losses = jax.lax.scan(
+                    batch_body, (params, upd, nst, it), (order, step_keys))
+                return (params, upd, nst, it), losses
+
+            carry0 = (params, updater_state, net_state, iteration0)
+            (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
+            return p, u, s, hist
+
+        fn = jax.jit(run, donate_argnums=(0, 1, 2))
+        self._epoch_steps[shuffle] = fn
+        return fn
+
+    def fused_epochs_supported(self) -> bool:
+        """True when this configuration can run the fused epoch program —
+        the ``fit_steps`` fallback matrix. Callers that pre-build a
+        ``DeviceDataSetCache`` (EarlyStoppingTrainer) gate on this BEFORE
+        paying the drain + HBM transfer."""
+        gc = self.conf.global_conf
+        return (gc.optimization_algo
+                == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+                and self.conf.backprop_type != BackpropType.TRUNCATED_BPTT
+                and not self.conf.pretrain
+                and gc.lr_policy != LearningRatePolicy.SCORE
+                and max(1, gc.iterations) == 1)
+
+    def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
+                   chunk_epochs: Optional[int] = None,
+                   cache_mb: Optional[float] = None):
+        """``fit(iterator)`` for ``num_epochs`` epochs with the dataset
+        cached in HBM and the whole training run fused: E epochs x N batches
+        execute as ONE donated XLA program per chunk (`lax.scan` over a
+        per-epoch device-side reshuffle, per-batch RNG keys) — one host
+        dispatch and zero re-transfers per chunk instead of E*N of each.
+        Returns the ``[E, N]`` per-batch loss history as a device array, or
+        ``None`` when a fallback path ran.
+
+        ``data`` may be a DataSetIterator, a list of DataSets, a single
+        DataSet, or a prebuilt ``DeviceDataSetCache`` (EarlyStoppingTrainer
+        builds one cache and re-runs chunks against it).
+
+        Chunking: listeners/checkpoint hooks need host decision points, so
+        with listeners attached the default chunk is ONE epoch (K
+        dispatches for K epochs — still N x fewer than streaming); without
+        them the whole run is a single program. ``chunk_epochs`` overrides.
+
+        Fallbacks (same matrix as ``fit_steps``): non-SGD solvers, TBPTT,
+        pretraining, the score-reactive LR policy, and ``iterations > 1``
+        run the plain per-step loop; datasets over the HBM budget
+        (``DL4J_DEVICE_CACHE_MB``) stream through an N-deep async device
+        prefetch instead (``DL4J_PREFETCH_DEPTH``)."""
+        self._ensure_init()
+        if num_epochs <= 0:
+            return None
+        if not self.conf.backprop and not self.conf.pretrain:
+            return None  # fit() trains nothing in this configuration
+        if not self.fused_epochs_supported():
+            if isinstance(data, DeviceDataSetCache):
+                raise ValueError(
+                    "this configuration needs the per-step fit loop "
+                    "(non-SGD solver / TBPTT / pretraining / SCORE policy) "
+                    "— pass the original iterator, not a DeviceDataSetCache")
+            for _ in range(num_epochs):
+                self.fit(data)
+            return None
+        cache = data if isinstance(data, DeviceDataSetCache) else (
+            DeviceDataSetCache.build(data, budget_mb=cache_mb))
+        if cache is None:
+            stream_epochs(self, data, num_epochs)
+            return None
+        step = self._epoch_train_step(shuffle)
+
+        def launch(epoch_keys):
+            (self.params, self.updater_state, self.net_state, hist) = step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(self.iteration_count, jnp.int32),
+                jnp.asarray(self._lr_scale_host, jnp.float32),
+                cache.features, cache.labels, cache.features_mask,
+                cache.labels_mask, epoch_keys)
+            return hist
+
+        return drive_epoch_chunks(self, cache, num_epochs, chunk_epochs,
+                                  launch)
+
     def _sgd_step(self, ds, rnn_state=None):
+        self._train_dispatches += 1
         self._rng, rng = jax.random.split(self._rng)
         (self.params, self.updater_state, self.net_state, new_rnn, loss) = (
             self._train_step(

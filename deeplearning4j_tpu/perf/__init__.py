@@ -11,6 +11,11 @@ Two pieces, shared by the whole scoring surface
   scatter-add into a ``[C, C]`` confusion matrix (and per-column sums for
   regression stats) that live in HBM across batches, so ``evaluate()``
   reads back one small array per call instead of per-batch logits.
+- ``epoch_cache`` — the training-side counterpart: the whole dataset
+  cached in HBM as ``[N, B, ...]`` stacks (under ``DL4J_DEVICE_CACHE_MB``)
+  so ``fit_epochs`` runs E epochs x N batches as ONE XLA program with a
+  device-side per-epoch reshuffle — one dispatch and zero re-transfers
+  per training run instead of E*N of each.
 """
 
 from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
@@ -26,4 +31,11 @@ from deeplearning4j_tpu.perf.device_eval import (  # noqa: F401
     confusion_update,
     init_regression_sums,
     regression_update,
+)
+from deeplearning4j_tpu.perf.epoch_cache import (  # noqa: F401
+    DeviceDataSetCache,
+    DeviceMultiDataSetCache,
+    cache_budget_mb,
+    epoch_schedule,
+    prefetch_depth,
 )

@@ -6,9 +6,12 @@
 #        scripts/verify.sh --eval     (just the eval/inference equivalence
 #                                      suite: device-vs-host metrics,
 #                                      recompile guard, bucketing)
-# The eval equivalence tests (tests/test_eval_device.py) are part of the
-# default tier-1 run; --eval is the narrow fast path for iterating on the
-# scoring surface.
+#        scripts/verify.sh --epoch    (just the epoch-pipeline equivalence
+#                                      suite: fit_epochs vs per-step
+#                                      bitwise, recompile guard, HBM-budget
+#                                      fallback)
+# The eval and epoch equivalence tests are part of the default tier-1 run;
+# --eval/--epoch are the narrow fast paths for iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -17,6 +20,9 @@ TARGET=tests/
 if [ "${1:-}" = "--eval" ]; then
     shift
     TARGET=tests/test_eval_device.py
+elif [ "${1:-}" = "--epoch" ]; then
+    shift
+    TARGET=tests/test_epoch_cache.py
 fi
 
 rm -f /tmp/_t1.log

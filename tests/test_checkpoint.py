@@ -149,6 +149,32 @@ class TestCheckpointRoundTrip:
         # >= stride saves at 14 (Δ14) and 28 (Δ14), never waits for 70
         assert latest_step(str(tmp_path)) == 28
 
+    def test_listener_stride_survives_fit_epochs_jumps(self, tmp_path):
+        """fit_epochs jumps iteration_count by chunk_epochs*N per listener
+        firing (E*N for a fully-fused chunk) — larger jumps than fit_steps'
+        K. The >= stride must keep firing at every multiple-crossing and
+        the saved step must be the jumped count, resumable as usual."""
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.optimize import CheckpointIterationListener
+
+        net = _trained_net(steps=0)
+        lst = CheckpointIterationListener(str(tmp_path), frequency=6)
+        net.set_listeners(lst)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        y = np.eye(3)[rng.integers(0, 3, 64)].astype(np.float32)
+        # listeners attached → chunk of 1 epoch → jumps of N=4: fires at
+        # 8 (Δ8 ≥ 6), not 12 (Δ4), 16 (Δ8), not 20, 24 — never modulo-6
+        hist = net.fit_epochs(ListDataSetIterator(DataSet(x, y), 16), 6)
+        assert hist is not None and net.iteration_count == 24
+        lst.close()
+        assert latest_step(str(tmp_path)) == 24
+        other = _trained_net(seed=5, steps=0)
+        restore_network(str(tmp_path), other)
+        assert other.iteration_count == 24
+        np.testing.assert_array_equal(other.get_flat_params(),
+                                      net.get_flat_params())
+
     def test_zero_size_leaves_round_trip(self, tmp_path):
         """SGD/NONE updater state holds zeros((0,)) placeholders, which
         Orbax refuses to serialize — they are stripped at save and

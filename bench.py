@@ -28,6 +28,10 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
   - infeed: async device-prefetch overlap vs synchronous feeding
   - epoch: HBM-cached whole-epoch fusion (fit_epochs) vs streaming
     per-step fit — samples/sec + measured dispatches-per-epoch
+  - dp_epoch: the SAME fused pipeline sharded over the data mesh
+    (ParallelWrapper.fit_epochs) — weak-scaling samples/sec/chip +
+    dispatches-per-epoch (must stay 1 at any device count); skipped
+    when only one device is visible
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs are analytic (formula noted per entry in "flops_source").
@@ -397,6 +401,59 @@ def bench_epoch():
             "total_samples": total}
 
 
+def bench_dp_epoch():
+    """Sharded epoch pipeline: whole-epoch fusion over the data mesh
+    (ParallelWrapper.fit_epochs). Weak scaling — per-chip batch held
+    constant as devices grow — reported as samples/sec/chip, plus the
+    invariant that the cached sharded path still makes exactly ONE
+    train-program dispatch per epoch chunk at ANY device count (the
+    composition PERF.md §Round-8 quantifies). Skips cleanly when only
+    one device is visible (the single-chip 'epoch' section covers n=1)."""
+    import jax
+
+    n = len(jax.devices())
+    if n < 2:
+        return {"skipped": f"only {n} device visible; dp_epoch needs >= 2",
+                "devices": n}
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models import mnist_mlp
+    from deeplearning4j_tpu.parallel import ParallelWrapper, build_mesh
+
+    rng = np.random.default_rng(0)
+    per_chip, n_batches, epochs = 256, 8, 5
+    batch = per_chip * n  # weak scaling: global batch grows with the mesh
+    total = batch * n_batches
+    ds = DataSet(rng.random((total, 784), np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, total)])
+    net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+    wrapper = ParallelWrapper(net, mesh=build_mesh())
+    cache = wrapper.build_epoch_cache(ListDataSetIterator(ds, batch))
+    if cache is None:
+        return {"error": "dataset exceeded the per-shard cache budget",
+                "devices": n}
+    wrapper.fit_epochs(cache, 1, chunk_epochs=1)  # warm the chunk program
+    _sync(net.params)
+    d0 = net._train_dispatches
+    t0 = time.perf_counter()
+    wrapper.fit_epochs(cache, epochs, chunk_epochs=1)
+    _sync(net.params)
+    sec = time.perf_counter() - t0
+    sps = total * epochs / sec
+    dpe = (net._train_dispatches - d0) / epochs
+    _log(f"dp_epoch: {n} devices, {sps:,.0f} samples/sec "
+         f"({sps / n:,.0f}/chip), {dpe:.2f} dispatches/epoch "
+         f"(cache sharded {cache.n_shard} ways)")
+    return {"devices": n, "global_batch": batch,
+            "per_chip_batch": per_chip, "n_batches": n_batches,
+            "epochs": epochs,
+            "samples_per_sec": round(sps, 1),
+            "samples_per_sec_per_chip": round(sps / n, 1),
+            "dispatches_per_epoch": round(dpe, 2),
+            "cache_n_shard": cache.n_shard,
+            "cache_mb_total": round(cache.nbytes / 1024 ** 2, 2)}
+
+
 def bench_eval():
     """Inference/eval path: device-resident confusion accumulation vs the
     host path (per-batch logit readback) on a stream of ragged batches.
@@ -511,13 +568,23 @@ def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
     }, tps, lm
 
 
-def bench_transformer(cpu_baseline=True):
+def bench_transformer(cpu_baseline=True, on_progress=None):
+    """``on_progress(partial_dict)`` is called after every sub-config so
+    the durable sidecar always holds the configs measured so far — a
+    wedge mid-sweep (this is the longest section) no longer loses the
+    whole transformer entry."""
     import jax
     import jax.numpy as jnp
 
     # batch sweep at t=1024 (the headline config family)
     sweep = {}
     best_tps, best_cfg = 0.0, None
+
+    def progress(**stages):
+        if on_progress is not None:
+            partial = {"partial": True, "batch_sweep_t1024": dict(sweep)}
+            partial.update(stages)
+            on_progress(partial)
     # batch sweep on the auto attention path, plus the Pallas flash
     # kernel FORCED at the best-batch config: the flash backward kernels
     # avoid the [b,h,t,t] f32 score-matrix HBM traffic both directions,
@@ -540,6 +607,7 @@ def bench_transformer(cpu_baseline=True):
         except Exception as e:
             sweep[label] = {"error": str(e)[:200]}
             _log(f"transformer b{batch} {attn} FAILED: {e}")
+        progress()
 
     # long-context config where the Pallas flash kernel engages
     try:
@@ -552,6 +620,7 @@ def bench_transformer(cpu_baseline=True):
     except Exception as e:
         flash_cfg = {"error": str(e)[:200]}
         _log(f"transformer t4096 FAILED: {e}")
+    progress(long_context_t4096=flash_cfg)
 
     # sliding-window at the same long-context shape: the banded flash
     # grid does O(t·window) work instead of O(t²/2) — the recorded
@@ -568,6 +637,7 @@ def bench_transformer(cpu_baseline=True):
     except Exception as e:
         win_cfg = {"error": str(e)[:200]}
         _log(f"transformer t4096 w1024 FAILED: {e}")
+    progress(long_context_t4096=flash_cfg, long_context_t4096_w1024=win_cfg)
 
     # vs_baseline is strictly like-for-like: the b16 t1024 TPU number over
     # the SAME config on XLA-CPU (the sweep's best batch may differ)
@@ -673,8 +743,13 @@ def _await_backend(timeout_s: float = None):
     ok, detail = _probe_backend_subprocess(probe_s)
     if not ok:
         _log(f"BACKEND UNAVAILABLE (child probe): {detail}")
-        print(_result_line({"error": f"backend unavailable: {detail}"},
-                           None, float("nan")), flush=True)
+        err = {"error": f"backend unavailable: {detail}"}
+        # the sidecar is the durable record: without this flush a wedged
+        # backend leaves a STALE bench_partial.json from a previous round
+        # masquerading as this run's result (BENCH_r05: rc=0, null metric,
+        # no trace of why)
+        _flush_partial(err, complete=True)
+        print(_result_line(err, None, float("nan")), flush=True)
         os._exit(0)
     _log(f"child probe ok: {detail}")
 
@@ -696,8 +771,9 @@ def _await_backend(timeout_s: float = None):
             "error", f"backend init did not complete in {timeout_s:.0f}s "
                      "after a successful child probe (grant re-wedged?)")
         _log(f"BACKEND UNAVAILABLE: {err}")
-        print(_result_line({"error": f"backend unavailable: {err}"},
-                           None, float("nan")), flush=True)
+        err_extras = {"error": f"backend unavailable: {err}"}
+        _flush_partial(err_extras, complete=True)
+        print(_result_line(err_extras, None, float("nan")), flush=True)
         os._exit(0)
     _log(f"backend up: {result['devices']}")
 
@@ -789,7 +865,8 @@ def main() -> None:
                 ("resnet18_cifar10", bench_resnet18),
                 ("infeed", bench_infeed),
                 ("eval", bench_eval),
-                ("epoch", bench_epoch)]
+                ("epoch", bench_epoch),
+                ("dp_epoch", bench_dp_epoch)]
     if only:
         known = {n for n, _ in sections} | {"transformer"}
         unknown = sorted(only - known)
@@ -810,7 +887,11 @@ def main() -> None:
         _flush_partial(extras)
 
     try:
-        tf, vs_baseline = bench_transformer()
+        def tf_progress(partial):
+            extras["transformer_lm"] = partial
+            _flush_partial(extras)
+
+        tf, vs_baseline = bench_transformer(on_progress=tf_progress)
         extras["transformer_lm"] = tf
         headline_value = tf.get("tokens_per_sec")
     except Exception as e:

@@ -2,15 +2,30 @@
 
 ``shard_map`` moved around across JAX releases: new versions export it at
 top level (``jax.shard_map``), older ones only under
-``jax.experimental.shard_map``. Import it from here so the parallel modules
-run on either layout.
+``jax.experimental.shard_map``. The replication-check kwarg was also
+renamed (``check_rep`` -> ``check_vma``). Import it from here so the
+parallel modules run on either layout/spelling: callers use the NEW
+``check_vma`` name and the shim translates for older signatures.
 """
 
 from __future__ import annotations
 
+import inspect
+
 try:  # jax >= 0.5-ish exports shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]  # noqa: F401
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
 except ImportError:  # jax 0.4.x keeps it experimental
-    from jax.experimental.shard_map import shard_map  # noqa: F401
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+if "check_vma" in _PARAMS or "check_rep" not in _PARAMS:
+    shard_map = _shard_map
+else:  # older signature: translate check_vma -> check_rep
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
 
 __all__ = ["shard_map"]

@@ -315,20 +315,16 @@ class EarlyStoppingTrainer:
         re-transferring the same data every epoch is exactly the cost the
         pipeline removes. The network's config predicate gates the build:
         a configuration the fused program cannot express must not pay the
-        drain + device transfer for a cache it would never use."""
+        drain + device transfer for a cache it would never use. The build
+        is delegated to the model handle, so a ``ParallelWrapper`` network
+        yields a MESH-SHARDED cache and every epoch runs as one SPMD
+        program over the data mesh."""
         if not (self.fuse_epochs and hasattr(self.network, "fit_epochs")):
             return None
         supported = getattr(self.network, "fused_epochs_supported", None)
         if supported is None or not supported():
             return None
-        from deeplearning4j_tpu.nn.graph import ComputationGraph
-        from deeplearning4j_tpu.perf.epoch_cache import (
-            DeviceDataSetCache, DeviceMultiDataSetCache)
-
-        builder = (DeviceMultiDataSetCache
-                   if isinstance(self.network, ComputationGraph)
-                   else DeviceDataSetCache)
-        return builder.build(self.train_iterator)
+        return self.network.build_epoch_cache(self.train_iterator)
 
     def fit(self) -> EarlyStoppingResult:
         conf = self.config

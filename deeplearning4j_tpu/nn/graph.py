@@ -57,7 +57,9 @@ from deeplearning4j_tpu.perf.bucketing import (
 from deeplearning4j_tpu.perf.device_eval import confusion_update
 from deeplearning4j_tpu.perf.epoch_cache import (
     DeviceMultiDataSetCache,
+    accum_steps_default,
     drive_epoch_chunks,
+    effective_accum_steps,
     epoch_schedule,
     stream_epochs,
 )
@@ -280,12 +282,29 @@ class ComputationGraph:
         return total, (new_state, new_rnn)
 
     # ------------------------------------------------------------------
+    def _apply_updaters(self, params, updater_state, grads, iteration):
+        """LR schedule + per-layer updater math + parameter update — the
+        tail every optimizer-step variant (plain, accumulated) shares."""
+        gc = self.conf.global_conf
+        scale = lr_policy_scale(
+            gc.lr_policy, iteration, gc.lr_policy_decay_rate,
+            gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
+            base_lr=gc.learning_rate)
+        new_params, new_updater = {}, {}
+        for name, spec in self.updater_specs.items():
+            steps_i, upd_i = apply_updater(
+                spec, grads[name], updater_state[name], scale,
+                iteration + 1)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
+            new_updater[name] = upd_i
+        return new_params, new_updater
+
     def _step_impl(self, params, updater_state, net_state, iteration,
                    inputs, labels, feature_masks, label_masks, rng,
                    rnn_state):
         """One optimizer step (pure; shared by the per-batch jitted step
         and the fused TBPTT scan body)."""
-        gc = self.conf.global_conf
         with dtypes_mod.policy_scope(self._policy):
             def loss_fn(p):
                 return self._loss_and_state(
@@ -294,19 +313,75 @@ class ComputationGraph:
 
             (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            scale = lr_policy_scale(
-                gc.lr_policy, iteration, gc.lr_policy_decay_rate,
-                gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
-                base_lr=gc.learning_rate)
-            new_params, new_updater = {}, {}
-            for name, spec in self.updater_specs.items():
-                steps_i, upd_i = apply_updater(
-                    spec, grads[name], updater_state[name], scale,
-                    iteration + 1)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
-                new_updater[name] = upd_i
+            new_params, new_updater = self._apply_updaters(
+                params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, new_rnn
+
+    def _accum_step_impl(self, params, updater_state, net_state, iteration,
+                         inputs, labels, feature_masks, label_masks, rng,
+                         accum_steps: int):
+        """One optimizer step over the full batch via ``accum_steps``
+        accumulated microbatches (the ComputationGraph counterpart of
+        MultiLayerNetwork._accum_step_impl): every output head's
+        microbatch loss is its masked SUM over the FULL batch's per-head
+        mask denominator (plus 1/K of the penalty), so the summed
+        gradients equal the unaccumulated step up to f32 summation
+        order. One updater apply."""
+        with dtypes_mod.policy_scope(self._policy):
+            k = accum_steps
+            micro = inputs[0].shape[0] // k
+
+            def split(a):
+                # strided (row i -> microbatch i % k): shard-local under
+                # a batch-sharded mesh (see MLN._accum_step_impl)
+                if a is None:
+                    return None
+                return jnp.moveaxis(
+                    a.reshape((micro, k) + a.shape[1:]), 1, 0)
+
+            d_full = tuple(jnp.maximum(jnp.sum(m), 1.0)
+                           for m in label_masks)
+            seq = {"x": tuple(split(a) for a in inputs),
+                   "y": tuple(split(a) for a in labels),
+                   "lm": tuple(split(a) for a in label_masks),
+                   "rng": jax.random.split(rng, k)}
+            if feature_masks is not None:
+                seq["fm"] = tuple(split(a) for a in feature_masks)
+
+            def micro_loss(p, nst_in, xm, ym, fmm, lmm, r):
+                outs, st, _ = self._forward(
+                    p, nst_in, xm, train=True, rng=r,
+                    feature_masks=fmm)
+                total = 0.0
+                for i, out_name in enumerate(self.conf.outputs):
+                    lc = self.conf.layers.get(out_name)
+                    if lc is None or not hasattr(lc, "loss_function"):
+                        continue
+                    core = compute_loss(
+                        lc.loss_function, outs[i], ym[i], lmm[i])
+                    d_mb = jnp.maximum(jnp.sum(lmm[i]), 1.0)
+                    total = total + core * (d_mb / d_full[i])
+                for name, impl in self.layer_impls.items():
+                    total = total + impl.l1_l2_penalty(p[name]) / k
+                return total, st
+
+            def body(carry, inp):
+                gsum, lsum, nst_in = carry
+                # grads wrt params only; net_state threads through the
+                # carry so no microbatch's state update is dropped
+                (lval, st), g = jax.value_and_grad(
+                    micro_loss, has_aux=True)(
+                    params, nst_in, inp["x"], inp["y"], inp.get("fm"),
+                    inp["lm"], inp["rng"])
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (gsum, lsum + lval, st), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (grads, loss, new_net_state), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
+            new_params, new_updater = self._apply_updaters(
+                params, updater_state, grads, iteration)
+        return new_params, new_updater, new_net_state, loss, None
 
     @functools.cached_property
     def _train_step(self):
@@ -379,14 +454,14 @@ class ComputationGraph:
     # whole-epoch fusion (the ComputationGraph counterpart of
     # MultiLayerNetwork.fit_epochs — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
-    def _epoch_train_step(self, shuffle: bool):
-        """E epochs x N batches scanned inside ONE donated XLA program over
-        the HBM-resident ``[N, B, ...]`` stacks (tuples per input/output
-        position); per-epoch device-side reshuffle via ``epoch_schedule``.
-        Returns the ``[E, N]`` loss history."""
-        fn = self._epoch_steps.get(shuffle)
-        if fn is not None:
-            return fn
+    def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1):
+        """The PURE chunk program: E epochs x N batches scanned over the
+        HBM-resident ``[N, B, ...]`` stacks (tuples per input/output
+        position); per-epoch device-side reshuffle via ``epoch_schedule``
+        (the permutation runs over the unsharded batch-index axis — on a
+        mesh the gathers stay shard-local). Returns ``(params, updater,
+        net_state, [E, N] hist)``. Shared by the single-device jit and
+        ``ParallelWrapper``'s SPMD jit."""
 
         def run(params, updater_state, net_state, iteration0, xs, ys, fms,
                 lms, epoch_keys):
@@ -399,11 +474,17 @@ class ComputationGraph:
                 def batch_body(c2, inp):
                     params, upd, nst, it = c2
                     i, rng = inp
-                    p2, u2, s2, loss, _ = self._step_impl(
-                        params, upd, nst, it,
-                        tuple(x[i] for x in xs), tuple(y[i] for y in ys),
-                        None if fms is None else tuple(m[i] for m in fms),
-                        tuple(m[i] for m in lms), rng, None)
+                    args = (params, upd, nst, it,
+                            tuple(x[i] for x in xs),
+                            tuple(y[i] for y in ys),
+                            None if fms is None
+                            else tuple(m[i] for m in fms),
+                            tuple(m[i] for m in lms), rng)
+                    if accum_steps > 1:
+                        p2, u2, s2, loss, _ = self._accum_step_impl(
+                            *args, accum_steps)
+                    else:
+                        p2, u2, s2, loss, _ = self._step_impl(*args, None)
                     return (p2, u2, s2, it + 1), loss
 
                 (params, upd, nst, it), losses = jax.lax.scan(
@@ -414,8 +495,17 @@ class ComputationGraph:
             (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
             return p, u, s, hist
 
-        fn = jax.jit(run, donate_argnums=(0, 1, 2))
-        self._epoch_steps[shuffle] = fn
+        return run
+
+    def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1):
+        """Jitted fused epoch program (one entry per (shuffle, accum));
+        params/updater/net state donated, dataset stacks resident."""
+        key = (shuffle, accum_steps)
+        fn = self._epoch_steps.get(key)
+        if fn is None:
+            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps),
+                         donate_argnums=(0, 1, 2))
+            self._epoch_steps[key] = fn
         return fn
 
     def fused_epochs_supported(self) -> bool:
@@ -429,19 +519,43 @@ class ComputationGraph:
         return (self.conf.backprop_type != BackpropType.TRUNCATED_BPTT
                 and max(1, self.conf.global_conf.iterations) == 1)
 
+    def build_epoch_cache(self, data, mesh=None,
+                          accum_steps: Optional[int] = None):
+        """Prebuild the HBM dataset cache ``fit_epochs`` would build.
+        ``mesh`` shards the batch axis over the mesh's ``data`` axis;
+        ``accum_steps=None`` resolves ``DL4J_ACCUM_STEPS``."""
+        if accum_steps is None:
+            accum_steps = accum_steps_default()
+        return DeviceMultiDataSetCache.build(data, mesh=mesh,
+                                             accum_steps=accum_steps)
+
+    def _place_replicated(self, mesh):
+        """Replicate params/updater/net state on ``mesh`` (see
+        MultiLayerNetwork._place_replicated)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        self.params = jax.device_put(self.params, repl)
+        self.updater_state = jax.device_put(self.updater_state, repl)
+        self.net_state = jax.device_put(self.net_state, repl)
+
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
-                   cache_mb: Optional[float] = None):
+                   cache_mb: Optional[float] = None, mesh=None,
+                   accum_steps: Optional[int] = None):
         """Whole-epoch fused training over a DataSet/MultiDataSet iterator
         (or a prebuilt ``DeviceMultiDataSetCache``) — same contract as
         MultiLayerNetwork.fit_epochs: one dispatch per chunk, per-epoch
         device-side reshuffle, ``[E, N]`` loss history returned (``None``
-        when a fallback ran). Falls back to the per-step loop for TBPTT and
-        ``iterations > 1``; over-budget datasets stream with N-deep async
-        device prefetch."""
+        when a fallback ran), ``mesh=``/``accum_steps=`` for SPMD batch
+        sharding and gradient accumulation. Falls back to the per-step
+        loop for TBPTT and ``iterations > 1``; over-budget datasets
+        stream with N-deep async device prefetch."""
         self._ensure_init()
         if num_epochs <= 0:
             return None
+        if accum_steps is None:
+            accum_steps = accum_steps_default()
         if not self.fused_epochs_supported():
             if isinstance(data, DeviceMultiDataSetCache):
                 raise ValueError(
@@ -452,11 +566,16 @@ class ComputationGraph:
                 self.fit(data)
             return None
         cache = data if isinstance(data, DeviceMultiDataSetCache) else (
-            DeviceMultiDataSetCache.build(data, budget_mb=cache_mb))
+            DeviceMultiDataSetCache.build(data, budget_mb=cache_mb,
+                                          mesh=mesh,
+                                          accum_steps=accum_steps))
         if cache is None:
             stream_epochs(self, data, num_epochs)
             return None
-        step = self._epoch_train_step(shuffle)
+        accum = effective_accum_steps(accum_steps, cache.batch)
+        if cache.mesh is not None:
+            self._place_replicated(cache.mesh)
+        step = self._epoch_train_step(shuffle, accum)
 
         def launch(epoch_keys):
             (self.params, self.updater_state, self.net_state, hist) = step(

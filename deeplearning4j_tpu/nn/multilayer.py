@@ -47,7 +47,9 @@ from deeplearning4j_tpu.perf.bucketing import (
 )
 from deeplearning4j_tpu.perf.epoch_cache import (
     DeviceDataSetCache,
+    accum_steps_default,
     drive_epoch_chunks,
+    effective_accum_steps,
     epoch_schedule,
     stream_epochs,
 )
@@ -199,10 +201,31 @@ class MultiLayerNetwork:
     # the jitted train step (replaces Solver/StochasticGradientDescent +
     # BaseUpdater for the SGD family)
     # ------------------------------------------------------------------
+    def _apply_updaters(self, params, updater_state, grads, iteration,
+                        lr_scale_host):
+        """LR schedule + per-layer updater math + parameter update — the
+        tail every optimizer-step variant (plain, accumulated) shares."""
+        gc = self.conf.global_conf
+        scale = lr_policy_scale(
+            gc.lr_policy, iteration, gc.lr_policy_decay_rate,
+            gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
+            base_lr=gc.learning_rate,
+        ) * lr_scale_host
+        new_params, new_updater = {}, {}
+        for i, spec in enumerate(self.updater_specs):
+            si = str(i)
+            steps_i, upd_i = apply_updater(
+                spec, grads[si], updater_state[si], scale, iteration + 1
+            )
+            new_params[si] = jax.tree_util.tree_map(
+                lambda p, s: p - s.astype(p.dtype), params[si], steps_i
+            )
+            new_updater[si] = upd_i
+        return new_params, new_updater
+
     def _step_impl(self, params, updater_state, net_state, iteration,
                    lr_scale_host, x, y, feature_mask, label_mask, rng,
                    rnn_state):
-        gc = self.conf.global_conf
         with dtypes_mod.policy_scope(self._policy):
             def loss_fn(p):
                 return self._loss_and_state(
@@ -213,22 +236,72 @@ class MultiLayerNetwork:
             (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            scale = lr_policy_scale(
-                gc.lr_policy, iteration, gc.lr_policy_decay_rate,
-                gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
-                base_lr=gc.learning_rate,
-            ) * lr_scale_host
-            new_params, new_updater = {}, {}
-            for i, spec in enumerate(self.updater_specs):
-                si = str(i)
-                steps_i, upd_i = apply_updater(
-                    spec, grads[si], updater_state[si], scale, iteration + 1
-                )
-                new_params[si] = jax.tree_util.tree_map(
-                    lambda p, s: p - s.astype(p.dtype), params[si], steps_i
-                )
-                new_updater[si] = upd_i
+            new_params, new_updater = self._apply_updaters(
+                params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, new_rnn, loss
+
+    def _accum_step_impl(self, params, updater_state, net_state, iteration,
+                         lr_scale_host, x, y, feature_mask, label_mask,
+                         rng, accum_steps: int):
+        """One optimizer step over the full batch via ``accum_steps``
+        accumulated microbatches: an inner ``lax.scan`` computes each
+        microbatch's share of the FULL-batch masked-mean loss (its masked
+        sum over the full batch's mask denominator, plus 1/K of the L1/L2
+        penalty), sums the gradients, and applies the updater ONCE. By
+        linearity this is the unaccumulated update up to f32 summation
+        order, while the live activation working set shrinks by K.
+        Caveats (documented in docs/training_pipeline.md): dropout draws
+        per microbatch, and train-mode batchnorm statistics chain K
+        per-microbatch updates instead of one full-batch update."""
+        with dtypes_mod.policy_scope(self._policy):
+            k = accum_steps
+            micro = x.shape[0] // k
+
+            def split(a):
+                # STRIDED split (row i -> microbatch i % k): under a
+                # batch-sharded mesh every microbatch then spans all
+                # shards evenly, so the slice stays shard-local (a
+                # contiguous split would pull each microbatch from a
+                # subset of the shards and force a resharding exchange)
+                if a is None:
+                    return None
+                return jnp.moveaxis(
+                    a.reshape((micro, k) + a.shape[1:]), 1, 0)
+
+            d_full = jnp.maximum(jnp.sum(label_mask), 1.0)
+            seq = {"x": split(x), "y": split(y), "lm": split(label_mask),
+                   "rng": jax.random.split(rng, k)}
+            if feature_mask is not None:
+                seq["fm"] = split(feature_mask)
+
+            def micro_loss(p, nst_in, xm, ym, fmm, lmm, r):
+                out, st, _, _ = self._forward(
+                    p, nst_in, xm, train=True, rng=r, feature_mask=fmm)
+                core = compute_loss(
+                    self._output_conf.loss_function, out, ym, lmm)
+                d_mb = jnp.maximum(jnp.sum(lmm), 1.0)
+                pen = 0.0
+                for i, impl in enumerate(self.layers):
+                    pen = pen + impl.l1_l2_penalty(p[str(i)])
+                return core * (d_mb / d_full) + pen / k, st
+
+            def body(carry, inp):
+                gsum, lsum, nst_in = carry
+                # grads wrt params only (argnum 0); net_state threads
+                # through the carry so NO microbatch's update is dropped
+                (lval, st), g = jax.value_and_grad(
+                    micro_loss, has_aux=True)(
+                    params, nst_in, inp["x"], inp["y"], inp.get("fm"),
+                    inp["lm"], inp["rng"])
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (gsum, lsum + lval, st), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (grads, loss, new_net_state), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
+            new_params, new_updater = self._apply_updaters(
+                params, updater_state, grads, iteration, lr_scale_host)
+        return new_params, new_updater, new_net_state, None, loss
 
     @functools.cached_property
     def _train_step(self):
@@ -367,17 +440,18 @@ class MultiLayerNetwork:
     # HBM-resident dataset cache (the epoch-level generalization of
     # fit_steps' single-batch fusion — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
-    def _epoch_train_step(self, shuffle: bool):
-        """Jitted program scanning chunk_epochs x n_batches optimizer steps:
-        outer ``lax.scan`` over epoch keys (each epoch derives a device-side
-        ``jax.random.permutation`` batch order + per-batch step keys via
-        ``epoch_schedule``), inner scan gathering batches from the resident
-        ``[N, B, ...]`` stacks. Params/updater/net state are donated; the
-        dataset stacks are NOT (they stay in HBM across chunks). Returns the
-        ``[E, N]`` loss history."""
-        fn = self._epoch_steps.get(shuffle)
-        if fn is not None:
-            return fn
+    def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1):
+        """The PURE chunk program: chunk_epochs x n_batches optimizer steps
+        — outer ``lax.scan`` over epoch keys (each epoch derives a
+        device-side ``jax.random.permutation`` batch order + per-batch step
+        keys via ``epoch_schedule``; the permutation runs over the
+        UNSHARDED batch-index axis, so on a mesh the gathers stay
+        shard-local and no resharding collective is emitted), inner scan
+        gathering batches from the resident ``[N, B, ...]`` stacks.
+        ``accum_steps > 1`` routes each batch through the microbatched
+        accumulation step. Returns ``(params, updater, net_state, [E, N]
+        hist)``. Shared verbatim by the single-device jit and
+        ``ParallelWrapper``'s SPMD jit (which pins out_shardings)."""
 
         def run(params, updater_state, net_state, iteration0, lr_scale_host,
                 xs, ys, fms, lms, epoch_keys):
@@ -390,11 +464,14 @@ class MultiLayerNetwork:
                 def batch_body(c2, inp):
                     params, upd, nst, it = c2
                     i, rng = inp
-                    p2, u2, s2, _, loss = self._step_impl(
-                        params, upd, nst, it, lr_scale_host,
-                        xs[i], ys[i],
-                        None if fms is None else fms[i], lms[i],
-                        rng, None)
+                    args = (params, upd, nst, it, lr_scale_host,
+                            xs[i], ys[i],
+                            None if fms is None else fms[i], lms[i], rng)
+                    if accum_steps > 1:
+                        p2, u2, s2, _, loss = self._accum_step_impl(
+                            *args, accum_steps)
+                    else:
+                        p2, u2, s2, _, loss = self._step_impl(*args, None)
                     return (p2, u2, s2, it + 1), loss
 
                 (params, upd, nst, it), losses = jax.lax.scan(
@@ -405,8 +482,18 @@ class MultiLayerNetwork:
             (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
             return p, u, s, hist
 
-        fn = jax.jit(run, donate_argnums=(0, 1, 2))
-        self._epoch_steps[shuffle] = fn
+        return run
+
+    def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1):
+        """Jitted fused epoch program (one entry per (shuffle, accum));
+        params/updater/net state are donated; the dataset stacks are NOT
+        (they stay in HBM across chunks)."""
+        key = (shuffle, accum_steps)
+        fn = self._epoch_steps.get(key)
+        if fn is None:
+            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps),
+                         donate_argnums=(0, 1, 2))
+            self._epoch_steps[key] = fn
         return fn
 
     def fused_epochs_supported(self) -> bool:
@@ -422,9 +509,33 @@ class MultiLayerNetwork:
                 and gc.lr_policy != LearningRatePolicy.SCORE
                 and max(1, gc.iterations) == 1)
 
+    def build_epoch_cache(self, data, mesh=None,
+                          accum_steps: Optional[int] = None):
+        """Prebuild the HBM dataset cache ``fit_epochs`` would build —
+        callers that re-run chunks (EarlyStoppingTrainer) pay the drain +
+        transfer once. ``mesh`` shards the batch axis over ``data``;
+        ``accum_steps=None`` resolves ``DL4J_ACCUM_STEPS`` so the budget's
+        working-set term prices the accumulation the run will use."""
+        if accum_steps is None:
+            accum_steps = accum_steps_default()
+        return DeviceDataSetCache.build(data, mesh=mesh,
+                                        accum_steps=accum_steps)
+
+    def _place_replicated(self, mesh):
+        """Replicate params/updater/net state on ``mesh`` so a sharded
+        dataset cache and the trainable state agree on device placement
+        (GSPMD then inserts the per-step gradient all-reduce)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        self.params = jax.device_put(self.params, repl)
+        self.updater_state = jax.device_put(self.updater_state, repl)
+        self.net_state = jax.device_put(self.net_state, repl)
+
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
-                   cache_mb: Optional[float] = None):
+                   cache_mb: Optional[float] = None, mesh=None,
+                   accum_steps: Optional[int] = None):
         """``fit(iterator)`` for ``num_epochs`` epochs with the dataset
         cached in HBM and the whole training run fused: E epochs x N batches
         execute as ONE donated XLA program per chunk (`lax.scan` over a
@@ -442,6 +553,14 @@ class MultiLayerNetwork:
         dispatches for K epochs — still N x fewer than streaming); without
         them the whole run is a single program. ``chunk_epochs`` overrides.
 
+        Mesh-aware: ``mesh=`` (or a prebuilt cache carrying one) shards
+        every batch over the mesh ``data`` axis and replicates
+        params/updater state on it — the chunk runs as ONE donated SPMD
+        program with GSPMD inserting the per-step gradient all-reduce
+        (use ``ParallelWrapper.fit_epochs`` for FSDP-sharded state).
+        ``accum_steps=K`` (default ``DL4J_ACCUM_STEPS``) runs each batch
+        as K accumulated microbatches with a single updater apply.
+
         Fallbacks (same matrix as ``fit_steps``): non-SGD solvers, TBPTT,
         pretraining, the score-reactive LR policy, and ``iterations > 1``
         run the plain per-step loop; datasets over the HBM budget
@@ -452,6 +571,8 @@ class MultiLayerNetwork:
             return None
         if not self.conf.backprop and not self.conf.pretrain:
             return None  # fit() trains nothing in this configuration
+        if accum_steps is None:
+            accum_steps = accum_steps_default()
         if not self.fused_epochs_supported():
             if isinstance(data, DeviceDataSetCache):
                 raise ValueError(
@@ -462,11 +583,15 @@ class MultiLayerNetwork:
                 self.fit(data)
             return None
         cache = data if isinstance(data, DeviceDataSetCache) else (
-            DeviceDataSetCache.build(data, budget_mb=cache_mb))
+            DeviceDataSetCache.build(data, budget_mb=cache_mb, mesh=mesh,
+                                     accum_steps=accum_steps))
         if cache is None:
             stream_epochs(self, data, num_epochs)
             return None
-        step = self._epoch_train_step(shuffle)
+        accum = effective_accum_steps(accum_steps, cache.batch)
+        if cache.mesh is not None:
+            self._place_replicated(cache.mesh)
+        step = self._epoch_train_step(shuffle, accum)
 
         def launch(epoch_keys):
             (self.params, self.updater_state, self.net_state, hist) = step(

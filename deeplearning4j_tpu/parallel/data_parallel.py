@@ -64,6 +64,7 @@ class ParallelWrapper:
         self.mesh = mesh or build_mesh()
         self._donate = donate
         self.fsdp = fsdp
+        self._epoch_steps = {}  # fused SPMD epoch program per (shuffle, K)
         network._ensure_init()
         self._place_params()
 
@@ -198,6 +199,140 @@ class ParallelWrapper:
             )
         net.score_value = float(loss)
         net._post_iteration()
+
+    # ------------------------------------------------------------------
+    # whole-epoch fusion over the mesh: the SPMD composition of
+    # ParallelWrapper's batch sharding with fit_epochs' one-program-per-
+    # chunk design (perf/epoch_cache.py) — batch sharded over 'data',
+    # params/updater replicated (or FSDP-sharded), GSPMD inserting the
+    # per-step gradient all-reduce; still ONE dispatch per epoch chunk
+    # at any device count.
+    # ------------------------------------------------------------------
+    def fused_epochs_supported(self) -> bool:
+        """The wrapped network's own fused-path matrix; the wrapper adds
+        no further exclusions (the chunk program is the network's)."""
+        supported = getattr(self.network, "fused_epochs_supported", None)
+        return bool(supported and supported())
+
+    def build_epoch_cache(self, data, accum_steps: Optional[int] = None):
+        """HBM dataset cache with every batch SHARDED over the mesh's
+        ``data`` axis — each chip holds B/n rows of every batch, so the
+        cacheable dataset size scales linearly with chip count.
+        ``accum_steps=None`` resolves ``DL4J_ACCUM_STEPS``."""
+        return self.network.build_epoch_cache(
+            data, mesh=self.mesh, accum_steps=accum_steps)
+
+    def _epoch_program(self, shuffle: bool, accum_steps: int):
+        """The network's pure chunk program jitted for SPMD execution:
+        out_shardings pinned so donated params/updater state STAY
+        replicated (or FSDP-sharded) across chunks instead of whatever
+        the partitioner would pick."""
+        key = (shuffle, accum_steps)
+        fn = self._epoch_steps.get(key)
+        if fn is None:
+            repl = NamedSharding(self.mesh, P())
+            if self.fsdp:
+                out = (self._param_shardings, self._upd_shardings,
+                       repl, repl)
+            else:
+                out = (repl, repl, repl, repl)
+            fn = jax.jit(self.network._epoch_run_fn(shuffle, accum_steps),
+                         donate_argnums=(0, 1, 2) if self._donate else (),
+                         out_shardings=out)
+            self._epoch_steps[key] = fn
+        return fn
+
+    def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
+                   chunk_epochs: Optional[int] = None,
+                   accum_steps: Optional[int] = None):
+        """``fit_epochs`` as ONE donated SPMD program per epoch chunk:
+        E epochs x N batches of `lax.scan` with the batch axis sharded
+        over the mesh ``data`` axis, params/updater replicated (or
+        sharded when ``fsdp=True``), the per-epoch reshuffle permuting
+        the unsharded batch-index axis (shard-local gathers, no
+        resharding collective) and GSPMD inserting one gradient
+        all-reduce per step. ``accum_steps=K`` scans K microbatches per
+        updater apply. Returns the ``[E, N]`` loss history, or ``None``
+        when a fallback ran (unsupported config -> the network's own
+        fallback matrix; over-budget dataset -> per-batch streaming
+        through ``AsyncDataSetIterator`` device prefetch — sharded via
+        the wrapper's step for MultiLayerNetwork, the network's own
+        single-device fit for ComputationGraph, which does not speak the
+        per-batch sharded-step protocol)."""
+        from deeplearning4j_tpu.perf.epoch_cache import (
+            DeviceDataSetCache, DeviceMultiDataSetCache,
+            accum_steps_default, drive_epoch_chunks, effective_accum_steps,
+            stream_epochs)
+
+        net = self.network
+        net._ensure_init()
+        if num_epochs <= 0:
+            return None
+        if not (getattr(net.conf, "backprop", True)
+                or getattr(net.conf, "pretrain", False)):
+            return None  # fit() trains nothing in this configuration
+        if accum_steps is None:
+            accum_steps = accum_steps_default()
+        prebuilt = isinstance(data, (DeviceDataSetCache,
+                                     DeviceMultiDataSetCache))
+        if not self.fused_epochs_supported():
+            if prebuilt:
+                raise ValueError(
+                    "this configuration needs the per-step fit loop — "
+                    "pass the original iterator, not a prebuilt cache")
+            # the network's own fit_epochs owns the fallback matrix;
+            # fsdp has no unsharded fallback (wrapper.fit raises for it)
+            if self.fsdp:
+                raise ValueError(
+                    "ParallelWrapper(fsdp=True) cannot run this "
+                    "configuration's per-step fallback; use fsdp=False")
+            net.fit_epochs(data, num_epochs, shuffle=shuffle,
+                           chunk_epochs=chunk_epochs)
+            return None
+        cache = data if prebuilt else self.build_epoch_cache(
+            data, accum_steps=accum_steps)
+        if cache is None:
+            # over budget even sharded: stream per batch THROUGH the
+            # sharded step (wrapper.fit), link hidden by device prefetch.
+            # ComputationGraph has no per-batch sharded step: fsdp=True
+            # would raise mid-stream from wrapper.fit, so fail HERE with
+            # the actionable levers instead; fsdp=False delegates to the
+            # graph's own single-device fit (wrapper.fit logs it).
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            if self.fsdp and not isinstance(net, MultiLayerNetwork):
+                raise ValueError(
+                    "dataset exceeds the per-shard cache budget and "
+                    "ComputationGraph has no fsdp streaming fallback — "
+                    "raise DL4J_DEVICE_CACHE_MB, set "
+                    "DL4J_CACHE_DTYPE=bfloat16, or increase accum_steps")
+            stream_epochs(self, data, num_epochs)
+            return None
+        accum = effective_accum_steps(accum_steps, cache.batch)
+        multi = isinstance(cache, DeviceMultiDataSetCache)
+        step = self._epoch_program(shuffle, accum)
+
+        def launch(epoch_keys):
+            with self.mesh:
+                if multi:
+                    (net.params, net.updater_state, net.net_state,
+                     hist) = step(
+                        net.params, net.updater_state, net.net_state,
+                        jnp.asarray(net.iteration_count, jnp.int32),
+                        cache.features, cache.labels, cache.features_masks,
+                        cache.labels_masks, epoch_keys)
+                else:
+                    (net.params, net.updater_state, net.net_state,
+                     hist) = step(
+                        net.params, net.updater_state, net.net_state,
+                        jnp.asarray(net.iteration_count, jnp.int32),
+                        jnp.asarray(net._lr_scale_host, jnp.float32),
+                        cache.features, cache.labels, cache.features_mask,
+                        cache.labels_mask, epoch_keys)
+            return hist
+
+        return drive_epoch_chunks(net, cache, num_epochs, chunk_epochs,
+                                  launch)
 
     def output(self, x):
         x = np.asarray(x)

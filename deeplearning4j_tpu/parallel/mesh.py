@@ -66,6 +66,16 @@ def local_device_count() -> int:
     return len(jax.devices())
 
 
+def data_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the ``data`` axis of ``mesh`` (1 when mesh is None or the
+    axis was dropped) — the data-parallel shard count a batch splits
+    into. Shared by the epoch cache's per-shard budget accounting and
+    the DP wrappers."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(DATA_AXIS, 1))
+
+
 def build_mesh(
     spec: Optional[MeshSpec] = None,
     devices: Optional[Sequence] = None,

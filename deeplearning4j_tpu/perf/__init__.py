@@ -12,10 +12,13 @@ Two pieces, shared by the whole scoring surface
   regression stats) that live in HBM across batches, so ``evaluate()``
   reads back one small array per call instead of per-batch logits.
 - ``epoch_cache`` — the training-side counterpart: the whole dataset
-  cached in HBM as ``[N, B, ...]`` stacks (under ``DL4J_DEVICE_CACHE_MB``)
-  so ``fit_epochs`` runs E epochs x N batches as ONE XLA program with a
-  device-side per-epoch reshuffle — one dispatch and zero re-transfers
-  per training run instead of E*N of each.
+  cached in HBM as ``[N, B, ...]`` stacks (under ``DL4J_DEVICE_CACHE_MB``,
+  optionally bf16 via ``DL4J_CACHE_DTYPE``, optionally batch-sharded over
+  a mesh's ``data`` axis) so ``fit_epochs`` runs E epochs x N batches as
+  ONE XLA program — SPMD via ``ParallelWrapper.fit_epochs`` — with a
+  device-side per-epoch reshuffle and optional gradient accumulation:
+  one dispatch and zero re-transfers per training run instead of E*N of
+  each, at any device count.
 """
 
 from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
@@ -35,7 +38,10 @@ from deeplearning4j_tpu.perf.device_eval import (  # noqa: F401
 from deeplearning4j_tpu.perf.epoch_cache import (  # noqa: F401
     DeviceDataSetCache,
     DeviceMultiDataSetCache,
+    accum_steps_default,
     cache_budget_mb,
+    cache_dtype,
+    effective_accum_steps,
     epoch_schedule,
     prefetch_depth,
 )

@@ -23,6 +23,27 @@ exceed it (or when batches cannot stack: ragged feature ranks, missing
 labels), and callers fall back to the streaming path with N-deep async device
 prefetch so the link overlaps compute instead of serializing with it.
 
+Mesh-aware (SPMD) caching: pass ``mesh=`` and the ``[N, B, ...]`` stacks are
+placed with a ``NamedSharding`` that shards the BATCH axis (axis 1) over the
+mesh's ``data`` axis — each chip holds only ``B/n_dp`` rows of every batch,
+so the budget check becomes per-shard and the cacheable dataset size scales
+linearly with chip count. The per-epoch reshuffle permutes the (unsharded)
+batch-index axis N, so the fused program's gathers are shard-local and GSPMD
+emits no resharding collective for the shuffle; the only per-step collective
+is the gradient all-reduce. When the bucket batch does not divide the data
+axis the stacks fall back to replicated placement (sharding here is an
+optimization, never a semantics change).
+
+Two more knobs tighten the per-chip HBM model (PERF.md §Round-8):
+``DL4J_CACHE_DTYPE=bfloat16`` stores the features/labels stacks in the
+compute dtype (masks stay f32), halving the resident footprint — fused-vs-
+per-step equivalence stays bitwise (both paths read the same cache) but
+results differ from full-f32 training by normal bf16 rounding. And
+``accum_steps=K`` (gradient accumulation) divides the per-step working-set
+term of the budget by K: the fused scan's live batch slice plus its
+gradient-side activations scale with the microbatch, so global batches whose
+step working set would overflow a chip still take the fused path.
+
 Pad rows are mask-inert through the loss (the labels mask is
 created-or-extended with zeros, exactly ``bucketing.pad_dataset``), with the
 same caveat: train-mode BatchNormalization computes batch statistics over all
@@ -32,6 +53,7 @@ rows, so padded TAIL batches skew its running averages — identical to
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -64,6 +86,87 @@ def prefetch_depth() -> int:
         return DEFAULT_PREFETCH_DEPTH
 
 
+def cache_dtype():
+    """Storage dtype for the features/labels stacks (``DL4J_CACHE_DTYPE``).
+    ``bfloat16``/``bf16`` halves the resident footprint; anything else
+    (including unset) keeps the source dtype. Masks are never narrowed —
+    they gate mask-weighted reductions and must stay exact."""
+    raw = os.environ.get("DL4J_CACHE_DTYPE", "").strip().lower()
+    if raw in ("bfloat16", "bf16"):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return None
+
+
+def accum_steps_default() -> int:
+    """Default gradient-accumulation factor for ``fit_epochs``
+    (``DL4J_ACCUM_STEPS``, default 1 = no accumulation)."""
+    raw = os.environ.get("DL4J_ACCUM_STEPS", "")
+    try:
+        return max(1, int(raw)) if raw else 1
+    except ValueError:
+        return 1
+
+
+def effective_accum_steps(requested: int, batch: int) -> int:
+    """Largest divisor of ``batch`` that is <= ``requested`` microbatches.
+    Accumulation needs the bucket batch to split evenly; rather than fail
+    a whole training run over an env default, clamp to the nearest
+    feasible factor (logged, since a weaker K also weakens the budget
+    relief the caller asked for)."""
+    requested = max(1, int(requested))
+    if requested <= 1 or batch <= 0:
+        return 1
+    batch = int(batch)
+    k = next(d for d in range(min(requested, batch), 0, -1)
+             if batch % d == 0)
+    if k != requested:
+        logging.getLogger(__name__).warning(
+            "accum_steps=%d does not divide the bucket batch %d; "
+            "clamped to %d", requested, batch, k)
+    return k
+
+
+def _data_shards(mesh) -> int:
+    """Size of the mesh ``data`` axis (1 when mesh is None or the axis was
+    dropped)."""
+    from deeplearning4j_tpu.parallel.mesh import data_axis_size
+
+    return data_axis_size(mesh)
+
+
+def _batch_sharding(mesh, ndim: int):
+    """NamedSharding for an ``[N, B, ...]`` stack: N replicated, B sharded
+    over ``data``, trailing dims replicated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+
+
+def _place(arr, mesh, sharded: bool = True):
+    """device_put ``arr`` with its batch axis sharded over the mesh's data
+    axis; replicated over the mesh when ``sharded`` is False (the bucket
+    batch did not tile the axis — same devices, no partitioning); plain
+    single-device placement when mesh is None."""
+    import jax
+
+    if arr is None:
+        return None
+    if mesh is None:
+        return jax.device_put(arr)
+    if not sharded:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(mesh, P()))
+    return jax.device_put(arr, _batch_sharding(mesh, arr.ndim))
+
+
 def epoch_schedule(epoch_key, n_batches: int, shuffle: bool):
     """(batch order, per-batch step keys) for one epoch, derived from one
     epoch key. Pure function of the key — the SAME derivation runs traced
@@ -78,11 +181,13 @@ def epoch_schedule(epoch_key, n_batches: int, shuffle: bool):
     return order, jax.random.split(step_key, n_batches)
 
 
-def _nbytes_padded(a, target_rows: int) -> int:
-    """Bytes of ``a`` with axis 0 padded to ``target_rows``."""
+def _nbytes_padded(a, target_rows: int, itemsize: Optional[int] = None) -> int:
+    """Bytes of ``a`` with axis 0 padded to ``target_rows`` (``itemsize``
+    overrides the source dtype's — the DL4J_CACHE_DTYPE narrowed store)."""
     if a is None:
         return 0
-    per_row = int(np.prod(a.shape[1:], dtype=np.int64)) * a.dtype.itemsize
+    size = a.dtype.itemsize if itemsize is None else itemsize
+    per_row = int(np.prod(a.shape[1:], dtype=np.int64)) * size
     return per_row * target_rows
 
 
@@ -126,7 +231,7 @@ class DeviceDataSetCache:
 
     def __init__(self, features, labels, features_mask, labels_mask,
                  n_batches: int, batch: int, total_examples: int,
-                 nbytes: int):
+                 nbytes: int, mesh=None, n_shard: int = 1):
         self.features = features          # [N, B, ...]
         self.labels = labels              # [N, B, ...]
         self.features_mask = features_mask  # [N, B, t] or None
@@ -134,16 +239,19 @@ class DeviceDataSetCache:
         self.n_batches = n_batches
         self.batch = batch
         self.total_examples = total_examples
-        self.nbytes = nbytes
+        self.nbytes = nbytes              # total across all shards
+        self.mesh = mesh                  # None = single-device placement
+        self.n_shard = n_shard            # data-axis shards holding the stacks
 
     @classmethod
     def build(cls, data, budget_mb: Optional[float] = None,
-              buckets: Optional[Sequence[int]] = None
-              ) -> Optional["DeviceDataSetCache"]:
+              buckets: Optional[Sequence[int]] = None, mesh=None,
+              accum_steps: int = 1) -> Optional["DeviceDataSetCache"]:
         budget = cache_budget_mb() if budget_mb is None else float(budget_mb)
         if budget <= 0:
             return None
         limit = budget * 1024 ** 2
+        n_shard = _data_shards(mesh)
         try:
             batches = _drain(data)
         except TypeError:
@@ -152,25 +260,44 @@ class DeviceDataSetCache:
             return None
         if any(getattr(ds, "labels", None) is None for ds in batches):
             return None  # loss needs labels; unsupervised streams stream
+        dtype = cache_dtype()
+        itemsize = None if dtype is None else np.dtype(dtype).itemsize
         target = 0
         running = 0
         for ds in batches:
             n = int(ds.features.shape[0])
             b = bucket_size(n, buckets)
             target = max(target, b)
-            running += (_nbytes_padded(ds.features, b)
-                        + _nbytes_padded(ds.labels, b))
-            if running > limit:
+            running += (_nbytes_padded(ds.features, b, itemsize)
+                        + _nbytes_padded(ds.labels, b, itemsize))
+            # optimistic early exit (final per-shard check governs): bail
+            # before stacking a dataset that cannot fit even when sharded
+            if running / n_shard > limit:
                 _reset(data)
                 return None
+        # bucket batch must tile the data axis to shard; otherwise the
+        # stacks replicate over the same mesh (placement is an
+        # optimization — never fail the build over it)
+        sharded = mesh is not None and target % n_shard == 0
+        if not sharded:
+            n_shard = 1
         total = 0
+        step_bytes = 0
         for ds in batches:
-            total += (_nbytes_padded(ds.features, target)
-                      + _nbytes_padded(ds.labels, target)
+            data_bytes = (_nbytes_padded(ds.features, target, itemsize)
+                          + _nbytes_padded(ds.labels, target, itemsize))
+            step_bytes = max(step_bytes, data_bytes)
+            total += (data_bytes
                       + _nbytes_padded(ds.features_mask, target)
                       + 4 * target * (1 if ds.labels.ndim == 2
                                       else int(ds.labels.shape[1])))
-        if total > limit:
+        # Per-chip HBM model (PERF.md §Round-8): the resident stacks divide
+        # across the data axis, and the fused scan's live working set — the
+        # gathered batch slice plus its gradient-side twin — divides further
+        # by the accumulation factor (microbatched inner scan).
+        accum = effective_accum_steps(accum_steps, target)
+        per_chip = total / n_shard + 2 * step_bytes / (n_shard * accum)
+        if per_chip > limit:
             _reset(data)
             return None
         any_fm = any(ds.features_mask is not None for ds in batches)
@@ -189,15 +316,17 @@ class DeviceDataSetCache:
         except ValueError:  # ragged trailing shapes — cannot stack
             _reset(data)
             return None
-        import jax
-
-        dev = jax.device_put
-        return cls(dev(features), dev(labels),
-                   None if fm is None else dev(fm), dev(lm),
+        if dtype is not None:
+            features = features.astype(dtype)
+            labels = labels.astype(dtype)
+        return cls(_place(features, mesh, sharded),
+                   _place(labels, mesh, sharded),
+                   None if fm is None else _place(fm, mesh, sharded),
+                   _place(lm, mesh, sharded),
                    n_batches=len(batches), batch=target,
                    total_examples=sum(int(ds.features.shape[0])
                                       for ds in batches),
-                   nbytes=total)
+                   nbytes=total, mesh=mesh, n_shard=n_shard)
 
 
 class DeviceMultiDataSetCache:
@@ -208,7 +337,7 @@ class DeviceMultiDataSetCache:
     def __init__(self, features: Tuple, labels: Tuple,
                  features_masks: Optional[Tuple], labels_masks: Tuple,
                  n_batches: int, batch: int, total_examples: int,
-                 nbytes: int):
+                 nbytes: int, mesh=None, n_shard: int = 1):
         self.features = features
         self.labels = labels
         self.features_masks = features_masks
@@ -217,17 +346,20 @@ class DeviceMultiDataSetCache:
         self.batch = batch
         self.total_examples = total_examples
         self.nbytes = nbytes
+        self.mesh = mesh
+        self.n_shard = n_shard
 
     @classmethod
     def build(cls, data, budget_mb: Optional[float] = None,
-              buckets: Optional[Sequence[int]] = None
-              ) -> Optional["DeviceMultiDataSetCache"]:
+              buckets: Optional[Sequence[int]] = None, mesh=None,
+              accum_steps: int = 1) -> Optional["DeviceMultiDataSetCache"]:
         from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
         budget = cache_budget_mb() if budget_mb is None else float(budget_mb)
         if budget <= 0:
             return None
         limit = budget * 1024 ** 2
+        n_shard = _data_shards(mesh)
         try:
             batches = _drain(data)
         except TypeError:
@@ -241,17 +373,22 @@ class DeviceMultiDataSetCache:
         if any(len(b.features) != n_in or len(b.labels) != n_out
                or any(l is None for l in b.labels) for b in batches):
             return None
+        dtype = cache_dtype()
+        itemsize = None if dtype is None else np.dtype(dtype).itemsize
         target = 0
         running = 0
         for mds in batches:
             n = int(mds.features[0].shape[0])
             b = bucket_size(n, buckets)
             target = max(target, b)
-            running += sum(_nbytes_padded(a, b)
+            running += sum(_nbytes_padded(a, b, itemsize)
                            for a in list(mds.features) + list(mds.labels))
-            if running > limit:
+            if running / n_shard > limit:
                 _reset(data)
                 return None
+        sharded = mesh is not None and target % n_shard == 0
+        if not sharded:
+            n_shard = 1
         try:
             features = tuple(
                 _stack_padded([b.features[i] for b in batches], target)
@@ -278,23 +415,28 @@ class DeviceMultiDataSetCache:
         except ValueError:
             _reset(data)
             return None
+        if dtype is not None:
+            features = tuple(a.astype(dtype) for a in features)
+            labels = tuple(a.astype(dtype) for a in labels)
         nbytes = sum(a.nbytes for a in features + labels + lms)
         if fms is not None:
             nbytes += sum(a.nbytes for a in fms)
-        if nbytes > limit:
+        # per-chip model: sharded resident stacks + the accumulated scan's
+        # per-step working set (one batch slice + gradient twin, /K)
+        step_bytes = sum(a[0].nbytes for a in features + labels)
+        accum = effective_accum_steps(accum_steps, target)
+        if nbytes / n_shard + 2 * step_bytes / (n_shard * accum) > limit:
             _reset(data)
             return None
-        import jax
-
-        dev = jax.device_put
-        return cls(tuple(dev(a) for a in features),
-                   tuple(dev(a) for a in labels),
-                   None if fms is None else tuple(dev(a) for a in fms),
-                   tuple(dev(a) for a in lms),
+        return cls(tuple(_place(a, mesh, sharded) for a in features),
+                   tuple(_place(a, mesh, sharded) for a in labels),
+                   None if fms is None else tuple(_place(a, mesh, sharded)
+                                                  for a in fms),
+                   tuple(_place(a, mesh, sharded) for a in lms),
                    n_batches=len(batches), batch=target,
                    total_examples=sum(int(b.features[0].shape[0])
                                       for b in batches),
-                   nbytes=nbytes)
+                   nbytes=nbytes, mesh=mesh, n_shard=n_shard)
 
 
 def drive_epoch_chunks(net, cache, num_epochs: int,

@@ -10,8 +10,14 @@
 #                                      suite: fit_epochs vs per-step
 #                                      bitwise, recompile guard, HBM-budget
 #                                      fallback)
-# The eval and epoch equivalence tests are part of the default tier-1 run;
-# --eval/--epoch are the narrow fast paths for iterating on those surfaces.
+#        scripts/verify.sh --dp       (just the data-parallel + sharded
+#                                      epoch suites on the forced 8-device
+#                                      host mesh: SPMD fit_epochs vs
+#                                      single-device, parameter averaging
+#                                      vs all-reduce, accumulation)
+# The eval/epoch/dp equivalence tests are part of the default tier-1 run;
+# --eval/--epoch/--dp are the narrow fast paths for iterating on those
+# surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -23,10 +29,23 @@ if [ "${1:-}" = "--eval" ]; then
 elif [ "${1:-}" = "--epoch" ]; then
     shift
     TARGET=tests/test_epoch_cache.py
+elif [ "${1:-}" = "--dp" ]; then
+    shift
+    TARGET="tests/test_dp_epoch.py tests/test_parallel.py"
 fi
 
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest "$TARGET" -q \
+# force the 8-device host mesh WITHOUT clobbering ambient XLA_FLAGS
+# (e.g. --xla_dump_to debugging); conftest.py does the same append for
+# direct pytest invocations
+case "${XLA_FLAGS:-}" in
+    *xla_force_host_platform_device_count*) ;;
+    *) XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+esac
+export XLA_FLAGS
+# shellcheck disable=SC2086  # TARGET may list several suites
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest $TARGET -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee /tmp/_t1.log

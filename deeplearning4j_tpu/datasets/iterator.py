@@ -344,12 +344,19 @@ class AsyncDataSetIterator(DataSetIterator):
             return False
 
         def producer():
+            produced = 0
             try:
                 while not stop.is_set() and self.underlying.has_next():
                     if not put_bounded(self._to_device(self.underlying.next())):
                         return
+                    produced += 1
             except BaseException as exc:  # re-raised on the consumer side
+                # the consumer sees this batches later (after draining the
+                # queued prefetch) — record WHICH batch the producer was on
+                # so an epoch-cache drain / streaming fallback can name the
+                # poisoned input instead of surfacing a bare queue error
                 state["error"] = exc
+                state["error_index"] = produced
             finally:
                 put_bounded(self._END)
 
@@ -366,8 +373,26 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._peek is self._END and self._producer_state["error"] is not None:
             exc = self._producer_state["error"]
             self._producer_state["error"] = None
-            raise exc
+            raise self._annotate(exc, self._producer_state)
         return self._peek is not self._END
+
+    @staticmethod
+    def _annotate(exc: BaseException, state: dict) -> BaseException:
+        """Attach the originating batch index to a producer exception
+        (``exc.batch_index`` + message suffix) WITHOUT changing its type —
+        callers' except clauses and retry filters keep matching, but an
+        ``build_epoch_cache`` drain or streaming fallback now names the
+        batch whose production failed."""
+        idx = state.get("error_index")
+        if idx is None or getattr(exc, "batch_index", None) is not None:
+            return exc
+        exc.batch_index = idx
+        note = f"[while producing batch #{idx}]"
+        if exc.args and isinstance(exc.args[0], str):
+            exc.args = (f"{exc.args[0]} {note}",) + exc.args[1:]
+        else:
+            exc.args = exc.args + (note,)
+        return exc
 
     def next(self, num=None):
         if not self.has_next():

@@ -347,8 +347,21 @@ class EarlyStoppingTrainer:
                 import numpy as np
 
                 hist = net.fit_epochs(cache, 1, chunk_epochs=1)
-                batch_scores = ([net.score_value] if hist is None else
-                                [float(s) for s in np.asarray(hist).ravel()])
+                if hist is None:
+                    batch_scores = [net.score_value]
+                else:
+                    flat = np.asarray(hist).ravel()
+                    # steps the numeric sentinel tripped were identity
+                    # steps — the DL4J_NAN_GUARD policy already handled
+                    # them in-program, so their recorded (non-finite)
+                    # losses must not double-trigger InvalidScore/
+                    # MaxScore iteration conditions here
+                    model = getattr(net, "network", net)
+                    trips = getattr(model, "_last_sentinel", None)
+                    if trips is not None:
+                        t = np.asarray(trips).ravel()[:flat.size]
+                        flat = flat[~t]
+                    batch_scores = [float(s) for s in flat]
                 for score in batch_scores:
                     for c in conf.iter_conditions:
                         if c.terminate(score):

@@ -105,7 +105,13 @@ class ComputationGraph:
         self._eval_readbacks = 0  # host transfers made by evaluate() calls
         self._eval_steps: Dict[int, Any] = {}  # jitted eval per output head
         self._train_dispatches = 0  # train-program launches (bench evidence)
-        self._epoch_steps: Dict[bool, Any] = {}  # fused epoch program per shuffle
+        self._epoch_steps: Dict[Any, Any] = {}  # fused program per (shuffle, K, guard)
+        # host LR multiplier — the halve_lr divergence policy's knob (the
+        # graph has no SCORE-reactive policy, so this stays 1.0 otherwise)
+        self._lr_scale_host = 1.0
+        self._last_sentinel = None  # [E, N] trip history of the last fit_epochs
+        self._epoch_cursor = 0  # epochs completed (checkpoint/resume cursor)
+        self._step_cursor = 0  # batches into the in-progress epoch (per-step path)
 
     @property
     def score_value(self) -> float:
@@ -282,14 +288,19 @@ class ComputationGraph:
         return total, (new_state, new_rnn)
 
     # ------------------------------------------------------------------
-    def _apply_updaters(self, params, updater_state, grads, iteration):
+    def _apply_updaters(self, params, updater_state, grads, iteration,
+                        lr_scale_host=None):
         """LR schedule + per-layer updater math + parameter update — the
-        tail every optimizer-step variant (plain, accumulated) shares."""
+        tail every optimizer-step variant (plain, accumulated, guarded)
+        shares. ``lr_scale_host`` (a traced scalar, or None = 1) is the
+        host LR multiplier the ``halve_lr`` divergence policy adjusts."""
         gc = self.conf.global_conf
         scale = lr_policy_scale(
             gc.lr_policy, iteration, gc.lr_policy_decay_rate,
             gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
             base_lr=gc.learning_rate)
+        if lr_scale_host is not None:
+            scale = scale * lr_scale_host
         new_params, new_updater = {}, {}
         for name, spec in self.updater_specs.items():
             steps_i, upd_i = apply_updater(
@@ -300,22 +311,89 @@ class ComputationGraph:
             new_updater[name] = upd_i
         return new_params, new_updater
 
+    def _loss_grads(self, params, net_state, inputs, labels,
+                    feature_masks, label_masks, rng, rnn_state=None):
+        """Training loss + gradients (pure; caller wraps the dtype policy
+        scope). Shared by the plain step and the sentinel-guarded step,
+        which needs the grads BEFORE deciding whether to apply them."""
+        def loss_fn(p):
+            return self._loss_and_state(
+                p, net_state, inputs, labels, feature_masks,
+                label_masks, rng, train=True, rnn_state=rnn_state)
+
+        return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
     def _step_impl(self, params, updater_state, net_state, iteration,
                    inputs, labels, feature_masks, label_masks, rng,
                    rnn_state):
         """One optimizer step (pure; shared by the per-batch jitted step
         and the fused TBPTT scan body)."""
         with dtypes_mod.policy_scope(self._policy):
-            def loss_fn(p):
-                return self._loss_and_state(
-                    p, net_state, inputs, labels, feature_masks,
-                    label_masks, rng, train=True, rnn_state=rnn_state)
-
-            (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            (loss, (new_net_state, new_rnn)), grads = self._loss_grads(
+                params, net_state, inputs, labels, feature_masks,
+                label_masks, rng, rnn_state)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, new_rnn
+
+    def _accum_loss_grads(self, params, net_state, inputs, labels,
+                          feature_masks, label_masks, rng,
+                          accum_steps: int):
+        """Accumulated-microbatch loss + summed gradients (pure; caller
+        wraps the dtype policy scope and applies the updater). Returns
+        ``(grads, loss, new_net_state)``."""
+        k = accum_steps
+        micro = inputs[0].shape[0] // k
+
+        def split(a):
+            # strided (row i -> microbatch i % k): shard-local under
+            # a batch-sharded mesh (see MLN._accum_step_impl)
+            if a is None:
+                return None
+            return jnp.moveaxis(
+                a.reshape((micro, k) + a.shape[1:]), 1, 0)
+
+        d_full = tuple(jnp.maximum(jnp.sum(m), 1.0)
+                       for m in label_masks)
+        seq = {"x": tuple(split(a) for a in inputs),
+               "y": tuple(split(a) for a in labels),
+               "lm": tuple(split(a) for a in label_masks),
+               "rng": jax.random.split(rng, k)}
+        if feature_masks is not None:
+            seq["fm"] = tuple(split(a) for a in feature_masks)
+
+        def micro_loss(p, nst_in, xm, ym, fmm, lmm, r):
+            outs, st, _ = self._forward(
+                p, nst_in, xm, train=True, rng=r,
+                feature_masks=fmm)
+            total = 0.0
+            for i, out_name in enumerate(self.conf.outputs):
+                lc = self.conf.layers.get(out_name)
+                if lc is None or not hasattr(lc, "loss_function"):
+                    continue
+                core = compute_loss(
+                    lc.loss_function, outs[i], ym[i], lmm[i])
+                d_mb = jnp.maximum(jnp.sum(lmm[i]), 1.0)
+                total = total + core * (d_mb / d_full[i])
+            for name, impl in self.layer_impls.items():
+                total = total + impl.l1_l2_penalty(p[name]) / k
+            return total, st
+
+        def body(carry, inp):
+            gsum, lsum, nst_in = carry
+            # grads wrt params only; net_state threads through the
+            # carry so no microbatch's state update is dropped
+            (lval, st), g = jax.value_and_grad(
+                micro_loss, has_aux=True)(
+                params, nst_in, inp["x"], inp["y"], inp.get("fm"),
+                inp["lm"], inp["rng"])
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            return (gsum, lsum + lval, st), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (grads, loss, new_net_state), _ = jax.lax.scan(
+            body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
+        return grads, loss, new_net_state
 
     def _accum_step_impl(self, params, updater_state, net_state, iteration,
                          inputs, labels, feature_masks, label_masks, rng,
@@ -328,60 +406,47 @@ class ComputationGraph:
         gradients equal the unaccumulated step up to f32 summation
         order. One updater apply."""
         with dtypes_mod.policy_scope(self._policy):
-            k = accum_steps
-            micro = inputs[0].shape[0] // k
-
-            def split(a):
-                # strided (row i -> microbatch i % k): shard-local under
-                # a batch-sharded mesh (see MLN._accum_step_impl)
-                if a is None:
-                    return None
-                return jnp.moveaxis(
-                    a.reshape((micro, k) + a.shape[1:]), 1, 0)
-
-            d_full = tuple(jnp.maximum(jnp.sum(m), 1.0)
-                           for m in label_masks)
-            seq = {"x": tuple(split(a) for a in inputs),
-                   "y": tuple(split(a) for a in labels),
-                   "lm": tuple(split(a) for a in label_masks),
-                   "rng": jax.random.split(rng, k)}
-            if feature_masks is not None:
-                seq["fm"] = tuple(split(a) for a in feature_masks)
-
-            def micro_loss(p, nst_in, xm, ym, fmm, lmm, r):
-                outs, st, _ = self._forward(
-                    p, nst_in, xm, train=True, rng=r,
-                    feature_masks=fmm)
-                total = 0.0
-                for i, out_name in enumerate(self.conf.outputs):
-                    lc = self.conf.layers.get(out_name)
-                    if lc is None or not hasattr(lc, "loss_function"):
-                        continue
-                    core = compute_loss(
-                        lc.loss_function, outs[i], ym[i], lmm[i])
-                    d_mb = jnp.maximum(jnp.sum(lmm[i]), 1.0)
-                    total = total + core * (d_mb / d_full[i])
-                for name, impl in self.layer_impls.items():
-                    total = total + impl.l1_l2_penalty(p[name]) / k
-                return total, st
-
-            def body(carry, inp):
-                gsum, lsum, nst_in = carry
-                # grads wrt params only; net_state threads through the
-                # carry so no microbatch's state update is dropped
-                (lval, st), g = jax.value_and_grad(
-                    micro_loss, has_aux=True)(
-                    params, nst_in, inp["x"], inp["y"], inp.get("fm"),
-                    inp["lm"], inp["rng"])
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
-                return (gsum, lsum + lval, st), None
-
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (grads, loss, new_net_state), _ = jax.lax.scan(
-                body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
+            grads, loss, new_net_state = self._accum_loss_grads(
+                params, net_state, inputs, labels, feature_masks,
+                label_masks, rng, accum_steps)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, None
+
+    def _guarded_step_impl(self, params, updater_state, net_state,
+                           iteration, lr_scale_host, inputs, labels,
+                           feature_masks, label_masks, rng,
+                           accum_steps: int):
+        """Sentinel-checked optimizer step for the fused epoch program
+        (see MultiLayerNetwork._guarded_step_impl): non-finite loss or
+        gradients skip the updater apply via ``lax.cond`` (params/
+        updater/net state carried unchanged) and raise the trip flag.
+        Returns ``(params, updater, net_state, loss, tripped)``."""
+        from deeplearning4j_tpu.resilience.guard import tree_all_finite
+
+        with dtypes_mod.policy_scope(self._policy):
+            if accum_steps > 1:
+                grads, loss, nst2 = self._accum_loss_grads(
+                    params, net_state, inputs, labels, feature_masks,
+                    label_masks, rng, accum_steps)
+            else:
+                (loss, (nst2, _)), grads = self._loss_grads(
+                    params, net_state, inputs, labels, feature_masks,
+                    label_masks, rng)
+            ok = jnp.isfinite(loss) & tree_all_finite(grads)
+
+            def apply(_):
+                p2, u2 = self._apply_updaters(
+                    params, updater_state, grads, iteration,
+                    lr_scale_host)
+                return p2, u2, nst2
+
+            def skip(_):
+                return params, updater_state, net_state
+
+            new_params, new_updater, new_nst = jax.lax.cond(
+                ok, apply, skip, None)
+        return new_params, new_updater, new_nst, loss, ~ok
 
     @functools.cached_property
     def _train_step(self):
@@ -454,17 +519,24 @@ class ComputationGraph:
     # whole-epoch fusion (the ComputationGraph counterpart of
     # MultiLayerNetwork.fit_epochs — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
-    def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1):
+    def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1,
+                      guard: bool = False):
         """The PURE chunk program: E epochs x N batches scanned over the
         HBM-resident ``[N, B, ...]`` stacks (tuples per input/output
         position); per-epoch device-side reshuffle via ``epoch_schedule``
         (the permutation runs over the unsharded batch-index axis — on a
-        mesh the gathers stay shard-local). Returns ``(params, updater,
-        net_state, [E, N] hist)``. Shared by the single-device jit and
-        ``ParallelWrapper``'s SPMD jit."""
+        mesh the gathers stay shard-local). ``lr_scale_host`` is the host
+        LR multiplier (a traced scalar — the halve_lr divergence policy
+        adjusts it between chunks without recompiling); the unguarded
+        step ignores it (it is 1.0 unless a guard policy changed it).
+        ``guard=True`` routes each step through the numeric sentinel and
+        returns ``(params, updater, net_state, [E, N] hist, [E, N]
+        trips)``; unguarded: ``(params, updater, net_state, hist)``.
+        Shared by the single-device jit and ``ParallelWrapper``'s SPMD
+        jit."""
 
-        def run(params, updater_state, net_state, iteration0, xs, ys, fms,
-                lms, epoch_keys):
+        def run(params, updater_state, net_state, iteration0,
+                lr_scale_host, xs, ys, fms, lms, epoch_keys):
             n = xs[0].shape[0]
 
             def epoch_body(carry, ekey):
@@ -474,12 +546,17 @@ class ComputationGraph:
                 def batch_body(c2, inp):
                     params, upd, nst, it = c2
                     i, rng = inp
-                    args = (params, upd, nst, it,
-                            tuple(x[i] for x in xs),
-                            tuple(y[i] for y in ys),
-                            None if fms is None
-                            else tuple(m[i] for m in fms),
-                            tuple(m[i] for m in lms), rng)
+                    batch = (tuple(x[i] for x in xs),
+                             tuple(y[i] for y in ys),
+                             None if fms is None
+                             else tuple(m[i] for m in fms),
+                             tuple(m[i] for m in lms), rng)
+                    if guard:
+                        p2, u2, s2, loss, tripped = self._guarded_step_impl(
+                            params, upd, nst, it, lr_scale_host, *batch,
+                            accum_steps)
+                        return (p2, u2, s2, it + 1), (loss, tripped)
+                    args = (params, upd, nst, it) + batch
                     if accum_steps > 1:
                         p2, u2, s2, loss, _ = self._accum_step_impl(
                             *args, accum_steps)
@@ -493,17 +570,22 @@ class ComputationGraph:
 
             carry0 = (params, updater_state, net_state, iteration0)
             (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
+            if guard:
+                losses, trips = hist
+                return p, u, s, losses, trips
             return p, u, s, hist
 
         return run
 
-    def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1):
-        """Jitted fused epoch program (one entry per (shuffle, accum));
-        params/updater/net state donated, dataset stacks resident."""
-        key = (shuffle, accum_steps)
+    def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1,
+                          guard: bool = False):
+        """Jitted fused epoch program (one entry per (shuffle, accum,
+        guard)); params/updater/net state donated, dataset stacks
+        resident."""
+        key = (shuffle, accum_steps, guard)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps),
+            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard),
                          donate_argnums=(0, 1, 2))
             self._epoch_steps[key] = fn
         return fn
@@ -542,15 +624,22 @@ class ComputationGraph:
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
                    cache_mb: Optional[float] = None, mesh=None,
-                   accum_steps: Optional[int] = None):
+                   accum_steps: Optional[int] = None,
+                   guard: Optional[str] = None, on_chunk=None):
         """Whole-epoch fused training over a DataSet/MultiDataSet iterator
         (or a prebuilt ``DeviceMultiDataSetCache``) — same contract as
         MultiLayerNetwork.fit_epochs: one dispatch per chunk, per-epoch
         device-side reshuffle, ``[E, N]`` loss history returned (``None``
         when a fallback ran), ``mesh=``/``accum_steps=`` for SPMD batch
-        sharding and gradient accumulation. Falls back to the per-step
-        loop for TBPTT and ``iterations > 1``; over-budget datasets
-        stream with N-deep async device prefetch."""
+        sharding and gradient accumulation, the in-program numeric
+        sentinel under the ``guard`` (``DL4J_NAN_GUARD``) policy with the
+        trip history in ``self._last_sentinel``, and
+        ``on_chunk(epochs_done) -> bool`` as the chunk-boundary
+        checkpoint/preemption hook. Falls back to the per-step loop for
+        TBPTT and ``iterations > 1``; over-budget datasets stream with
+        N-deep async device prefetch."""
+        from deeplearning4j_tpu.resilience.guard import nan_guard_policy
+
         self._ensure_init()
         if num_epochs <= 0:
             return None
@@ -575,18 +664,44 @@ class ComputationGraph:
         accum = effective_accum_steps(accum_steps, cache.batch)
         if cache.mesh is not None:
             self._place_replicated(cache.mesh)
-        step = self._epoch_train_step(shuffle, accum)
+        guard = nan_guard_policy() if guard is None else guard
+        guarded = guard != "off"
+        step = self._epoch_train_step(shuffle, accum, guarded)
 
         def launch(epoch_keys):
-            (self.params, self.updater_state, self.net_state, hist) = step(
+            out = step(
                 self.params, self.updater_state, self.net_state,
                 jnp.asarray(self.iteration_count, jnp.int32),
+                jnp.asarray(self._lr_scale_host, jnp.float32),
                 cache.features, cache.labels, cache.features_masks,
                 cache.labels_masks, epoch_keys)
-            return hist
+            if guarded:
+                (self.params, self.updater_state, self.net_state,
+                 hist, trips) = out
+                return hist, trips
+            (self.params, self.updater_state, self.net_state, hist) = out
+            return hist, None
+
+        def replay_step(params, upd, nst, it, i, rng):
+            # per-step replay for DL4J_NAN_GUARD=raise localization —
+            # accumulation split included, matching the fused run's
+            # per-microbatch rng stream
+            args = (params, upd, nst, jnp.asarray(it, jnp.int32),
+                    tuple(x[i] for x in cache.features),
+                    tuple(y[i] for y in cache.labels),
+                    None if cache.features_masks is None
+                    else tuple(m[i] for m in cache.features_masks),
+                    tuple(m[i] for m in cache.labels_masks), rng)
+            if accum > 1:
+                p, u, s, loss, _ = self._accum_step_impl(*args, accum)
+            else:
+                p, u, s, loss, _ = self._train_step(*args, None)
+            return p, u, s, loss
 
         return drive_epoch_chunks(self, cache, num_epochs, chunk_epochs,
-                                  launch)
+                                  launch, shuffle=shuffle, guard=guard,
+                                  replay_step=replay_step,
+                                  on_chunk=on_chunk)
 
     @functools.cached_property
     def _output_fn(self):

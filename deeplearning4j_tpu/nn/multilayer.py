@@ -82,7 +82,10 @@ class MultiLayerNetwork:
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
         self._eval_readbacks = 0  # host transfers made by evaluate() calls
         self._train_dispatches = 0  # train-program launches (bench evidence)
-        self._epoch_steps: Dict[bool, Any] = {}  # fused epoch program per shuffle
+        self._epoch_steps: Dict[Any, Any] = {}  # fused program per (shuffle, K, guard)
+        self._last_sentinel = None  # [E, N] trip history of the last fit_epochs
+        self._epoch_cursor = 0  # epochs completed (checkpoint/resume cursor)
+        self._step_cursor = 0  # batches into the in-progress epoch (per-step path)
 
     @property
     def score_value(self) -> float:
@@ -223,22 +226,82 @@ class MultiLayerNetwork:
             new_updater[si] = upd_i
         return new_params, new_updater
 
+    def _loss_grads(self, params, net_state, x, y, feature_mask,
+                    label_mask, rng, rnn_state=None):
+        """Training loss + gradients (pure; caller wraps the dtype
+        policy scope). Shared by the plain step and the sentinel-guarded
+        step, which needs the grads BEFORE deciding whether to apply
+        them."""
+        def loss_fn(p):
+            return self._loss_and_state(
+                p, net_state, x, y, feature_mask, label_mask, rng,
+                train=True, rnn_state=rnn_state,
+            )
+
+        return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
     def _step_impl(self, params, updater_state, net_state, iteration,
                    lr_scale_host, x, y, feature_mask, label_mask, rng,
                    rnn_state):
         with dtypes_mod.policy_scope(self._policy):
-            def loss_fn(p):
-                return self._loss_and_state(
-                    p, net_state, x, y, feature_mask, label_mask, rng,
-                    train=True, rnn_state=rnn_state,
-                )
-
-            (loss, (new_net_state, new_rnn)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+            (loss, (new_net_state, new_rnn)), grads = self._loss_grads(
+                params, net_state, x, y, feature_mask, label_mask, rng,
+                rnn_state)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, new_rnn, loss
+
+    def _accum_loss_grads(self, params, net_state, x, y, feature_mask,
+                          label_mask, rng, accum_steps: int):
+        """Accumulated-microbatch loss + summed gradients (pure; caller
+        wraps the dtype policy scope and applies the updater). Returns
+        ``(grads, loss, new_net_state)``."""
+        k = accum_steps
+        micro = x.shape[0] // k
+
+        def split(a):
+            # STRIDED split (row i -> microbatch i % k): under a
+            # batch-sharded mesh every microbatch then spans all
+            # shards evenly, so the slice stays shard-local (a
+            # contiguous split would pull each microbatch from a
+            # subset of the shards and force a resharding exchange)
+            if a is None:
+                return None
+            return jnp.moveaxis(
+                a.reshape((micro, k) + a.shape[1:]), 1, 0)
+
+        d_full = jnp.maximum(jnp.sum(label_mask), 1.0)
+        seq = {"x": split(x), "y": split(y), "lm": split(label_mask),
+               "rng": jax.random.split(rng, k)}
+        if feature_mask is not None:
+            seq["fm"] = split(feature_mask)
+
+        def micro_loss(p, nst_in, xm, ym, fmm, lmm, r):
+            out, st, _, _ = self._forward(
+                p, nst_in, xm, train=True, rng=r, feature_mask=fmm)
+            core = compute_loss(
+                self._output_conf.loss_function, out, ym, lmm)
+            d_mb = jnp.maximum(jnp.sum(lmm), 1.0)
+            pen = 0.0
+            for i, impl in enumerate(self.layers):
+                pen = pen + impl.l1_l2_penalty(p[str(i)])
+            return core * (d_mb / d_full) + pen / k, st
+
+        def body(carry, inp):
+            gsum, lsum, nst_in = carry
+            # grads wrt params only (argnum 0); net_state threads
+            # through the carry so NO microbatch's update is dropped
+            (lval, st), g = jax.value_and_grad(
+                micro_loss, has_aux=True)(
+                params, nst_in, inp["x"], inp["y"], inp.get("fm"),
+                inp["lm"], inp["rng"])
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            return (gsum, lsum + lval, st), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (grads, loss, new_net_state), _ = jax.lax.scan(
+            body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
+        return grads, loss, new_net_state
 
     def _accum_step_impl(self, params, updater_state, net_state, iteration,
                          lr_scale_host, x, y, feature_mask, label_mask,
@@ -254,54 +317,52 @@ class MultiLayerNetwork:
         per microbatch, and train-mode batchnorm statistics chain K
         per-microbatch updates instead of one full-batch update."""
         with dtypes_mod.policy_scope(self._policy):
-            k = accum_steps
-            micro = x.shape[0] // k
-
-            def split(a):
-                # STRIDED split (row i -> microbatch i % k): under a
-                # batch-sharded mesh every microbatch then spans all
-                # shards evenly, so the slice stays shard-local (a
-                # contiguous split would pull each microbatch from a
-                # subset of the shards and force a resharding exchange)
-                if a is None:
-                    return None
-                return jnp.moveaxis(
-                    a.reshape((micro, k) + a.shape[1:]), 1, 0)
-
-            d_full = jnp.maximum(jnp.sum(label_mask), 1.0)
-            seq = {"x": split(x), "y": split(y), "lm": split(label_mask),
-                   "rng": jax.random.split(rng, k)}
-            if feature_mask is not None:
-                seq["fm"] = split(feature_mask)
-
-            def micro_loss(p, nst_in, xm, ym, fmm, lmm, r):
-                out, st, _, _ = self._forward(
-                    p, nst_in, xm, train=True, rng=r, feature_mask=fmm)
-                core = compute_loss(
-                    self._output_conf.loss_function, out, ym, lmm)
-                d_mb = jnp.maximum(jnp.sum(lmm), 1.0)
-                pen = 0.0
-                for i, impl in enumerate(self.layers):
-                    pen = pen + impl.l1_l2_penalty(p[str(i)])
-                return core * (d_mb / d_full) + pen / k, st
-
-            def body(carry, inp):
-                gsum, lsum, nst_in = carry
-                # grads wrt params only (argnum 0); net_state threads
-                # through the carry so NO microbatch's update is dropped
-                (lval, st), g = jax.value_and_grad(
-                    micro_loss, has_aux=True)(
-                    params, nst_in, inp["x"], inp["y"], inp.get("fm"),
-                    inp["lm"], inp["rng"])
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
-                return (gsum, lsum + lval, st), None
-
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (grads, loss, new_net_state), _ = jax.lax.scan(
-                body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
+            grads, loss, new_net_state = self._accum_loss_grads(
+                params, net_state, x, y, feature_mask, label_mask, rng,
+                accum_steps)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, None, loss
+
+    def _guarded_step_impl(self, params, updater_state, net_state,
+                           iteration, lr_scale_host, x, y, feature_mask,
+                           label_mask, rng, accum_steps: int):
+        """Sentinel-checked optimizer step for the fused epoch program:
+        compute loss + gradients, trip when the loss or ANY gradient
+        element is non-finite, and ``lax.cond`` between the updater apply
+        and identity — a tripped step carries params/updater/net state
+        through unchanged, containing a poisoned batch to exactly one
+        skipped update instead of E*N poisoned steps. Returns ``(params,
+        updater, net_state, loss, tripped)``; the iteration counter
+        advances either way so LR schedules stay aligned with an
+        uninterrupted run. The raw (possibly non-finite) loss is recorded
+        in the history — the host-side ``DL4J_NAN_GUARD`` policy reads
+        the trip flags, not the losses (see resilience/guard.py)."""
+        from deeplearning4j_tpu.resilience.guard import tree_all_finite
+
+        with dtypes_mod.policy_scope(self._policy):
+            if accum_steps > 1:
+                grads, loss, nst2 = self._accum_loss_grads(
+                    params, net_state, x, y, feature_mask, label_mask,
+                    rng, accum_steps)
+            else:
+                (loss, (nst2, _)), grads = self._loss_grads(
+                    params, net_state, x, y, feature_mask, label_mask,
+                    rng)
+            ok = jnp.isfinite(loss) & tree_all_finite(grads)
+
+            def apply(_):
+                p2, u2 = self._apply_updaters(
+                    params, updater_state, grads, iteration,
+                    lr_scale_host)
+                return p2, u2, nst2
+
+            def skip(_):
+                return params, updater_state, net_state
+
+            new_params, new_updater, new_nst = jax.lax.cond(
+                ok, apply, skip, None)
+        return new_params, new_updater, new_nst, loss, ~ok
 
     @functools.cached_property
     def _train_step(self):
@@ -440,7 +501,8 @@ class MultiLayerNetwork:
     # HBM-resident dataset cache (the epoch-level generalization of
     # fit_steps' single-batch fusion — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
-    def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1):
+    def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1,
+                      guard: bool = False):
         """The PURE chunk program: chunk_epochs x n_batches optimizer steps
         — outer ``lax.scan`` over epoch keys (each epoch derives a
         device-side ``jax.random.permutation`` batch order + per-batch step
@@ -449,9 +511,12 @@ class MultiLayerNetwork:
         shard-local and no resharding collective is emitted), inner scan
         gathering batches from the resident ``[N, B, ...]`` stacks.
         ``accum_steps > 1`` routes each batch through the microbatched
-        accumulation step. Returns ``(params, updater, net_state, [E, N]
-        hist)``. Shared verbatim by the single-device jit and
-        ``ParallelWrapper``'s SPMD jit (which pins out_shardings)."""
+        accumulation step. ``guard=True`` routes each step through the
+        numeric sentinel (``_guarded_step_impl``) and returns ``(params,
+        updater, net_state, [E, N] hist, [E, N] trips)``; unguarded the
+        trips slot is absent: ``(params, updater, net_state, hist)``.
+        Shared verbatim by the single-device jit and ``ParallelWrapper``'s
+        SPMD jit (which pins out_shardings)."""
 
         def run(params, updater_state, net_state, iteration0, lr_scale_host,
                 xs, ys, fms, lms, epoch_keys):
@@ -467,6 +532,10 @@ class MultiLayerNetwork:
                     args = (params, upd, nst, it, lr_scale_host,
                             xs[i], ys[i],
                             None if fms is None else fms[i], lms[i], rng)
+                    if guard:
+                        p2, u2, s2, loss, tripped = self._guarded_step_impl(
+                            *args, accum_steps)
+                        return (p2, u2, s2, it + 1), (loss, tripped)
                     if accum_steps > 1:
                         p2, u2, s2, _, loss = self._accum_step_impl(
                             *args, accum_steps)
@@ -480,18 +549,22 @@ class MultiLayerNetwork:
 
             carry0 = (params, updater_state, net_state, iteration0)
             (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
+            if guard:
+                losses, trips = hist
+                return p, u, s, losses, trips
             return p, u, s, hist
 
         return run
 
-    def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1):
-        """Jitted fused epoch program (one entry per (shuffle, accum));
-        params/updater/net state are donated; the dataset stacks are NOT
-        (they stay in HBM across chunks)."""
-        key = (shuffle, accum_steps)
+    def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1,
+                          guard: bool = False):
+        """Jitted fused epoch program (one entry per (shuffle, accum,
+        guard)); params/updater/net state are donated; the dataset stacks
+        are NOT (they stay in HBM across chunks)."""
+        key = (shuffle, accum_steps, guard)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps),
+            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard),
                          donate_argnums=(0, 1, 2))
             self._epoch_steps[key] = fn
         return fn
@@ -535,7 +608,8 @@ class MultiLayerNetwork:
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
                    cache_mb: Optional[float] = None, mesh=None,
-                   accum_steps: Optional[int] = None):
+                   accum_steps: Optional[int] = None,
+                   guard: Optional[str] = None, on_chunk=None):
         """``fit(iterator)`` for ``num_epochs`` epochs with the dataset
         cached in HBM and the whole training run fused: E epochs x N batches
         execute as ONE donated XLA program per chunk (`lax.scan` over a
@@ -561,11 +635,26 @@ class MultiLayerNetwork:
         ``accum_steps=K`` (default ``DL4J_ACCUM_STEPS``) runs each batch
         as K accumulated microbatches with a single updater apply.
 
+        Self-healing: every fused step runs under the in-program numeric
+        sentinel unless ``guard`` (default: the ``DL4J_NAN_GUARD`` env
+        policy, default ``skip``) is ``"off"`` — a non-finite loss or
+        gradient skips that step in-program (params/updater state carried
+        unchanged), the ``[E, N]`` trip history lands in
+        ``self._last_sentinel``, and the policy is enforced per chunk
+        (``skip`` logs, ``halve_lr`` halves the host LR scale, ``raise``
+        replays the chunk per-step from the last-good snapshot and raises
+        ``TrainingDivergedError`` naming the epoch/step/batch).
+        ``on_chunk(epochs_done) -> bool`` fires at every chunk boundary
+        (True stops the run) — the preemption-safe checkpoint hook. The
+        per-step fallback paths are NOT sentinel-guarded.
+
         Fallbacks (same matrix as ``fit_steps``): non-SGD solvers, TBPTT,
         pretraining, the score-reactive LR policy, and ``iterations > 1``
         run the plain per-step loop; datasets over the HBM budget
         (``DL4J_DEVICE_CACHE_MB``) stream through an N-deep async device
         prefetch instead (``DL4J_PREFETCH_DEPTH``)."""
+        from deeplearning4j_tpu.resilience.guard import nan_guard_policy
+
         self._ensure_init()
         if num_epochs <= 0:
             return None
@@ -591,19 +680,45 @@ class MultiLayerNetwork:
         accum = effective_accum_steps(accum_steps, cache.batch)
         if cache.mesh is not None:
             self._place_replicated(cache.mesh)
-        step = self._epoch_train_step(shuffle, accum)
+        guard = nan_guard_policy() if guard is None else guard
+        guarded = guard != "off"
+        step = self._epoch_train_step(shuffle, accum, guarded)
 
         def launch(epoch_keys):
-            (self.params, self.updater_state, self.net_state, hist) = step(
+            out = step(
                 self.params, self.updater_state, self.net_state,
                 jnp.asarray(self.iteration_count, jnp.int32),
                 jnp.asarray(self._lr_scale_host, jnp.float32),
                 cache.features, cache.labels, cache.features_mask,
                 cache.labels_mask, epoch_keys)
-            return hist
+            if guarded:
+                (self.params, self.updater_state, self.net_state,
+                 hist, trips) = out
+                return hist, trips
+            (self.params, self.updater_state, self.net_state, hist) = out
+            return hist, None
+
+        def replay_step(params, upd, nst, it, i, rng):
+            # per-step replay for DL4J_NAN_GUARD=raise localization: the
+            # same step math on the same cache slice with the same key —
+            # including the accumulation split, whose per-microbatch rng
+            # draws the fused run consumed
+            args = (params, upd, nst, jnp.asarray(it, jnp.int32),
+                    jnp.asarray(self._lr_scale_host, jnp.float32),
+                    cache.features[i], cache.labels[i],
+                    None if cache.features_mask is None
+                    else cache.features_mask[i],
+                    cache.labels_mask[i], rng)
+            if accum > 1:
+                p, u, s, _, loss = self._accum_step_impl(*args, accum)
+            else:
+                p, u, s, _, loss = self._train_step(*args, None)
+            return p, u, s, loss
 
         return drive_epoch_chunks(self, cache, num_epochs, chunk_epochs,
-                                  launch)
+                                  launch, shuffle=shuffle, guard=guard,
+                                  replay_step=replay_step,
+                                  on_chunk=on_chunk)
 
     def _sgd_step(self, ds, rnn_state=None):
         self._train_dispatches += 1

@@ -15,11 +15,14 @@ O(params) cheap).
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+logger = logging.getLogger(__name__)
 
 
 class TerminationCondition:
@@ -37,6 +40,10 @@ class EpsTermination(TerminationCondition):
         self.tolerance = tolerance
 
     def terminate(self, new_score, old_score, grad, direction):
+        # old_score is +inf before the first evaluation (no improvement
+        # to measure yet). NON-finite scores mid-run never reach here:
+        # ``minimize`` routes them through the DL4J_NAN_GUARD policy
+        # before the termination checks run.
         if not np.isfinite(old_score):
             return False
         return abs(new_score - old_score) < self.eps + self.tolerance * abs(
@@ -98,7 +105,8 @@ def minimize(value_and_grad: Callable, params0: np.ndarray,
              terminations: Optional[Sequence[TerminationCondition]] = None,
              callback: Optional[Callable[[np.ndarray, float, int], None]]
              = None,
-             rescore_final: bool = True
+             rescore_final: bool = True,
+             nan_guard: Optional[str] = None
              ) -> Tuple[np.ndarray, float, List[float]]:
     """Minimize a scalar function of a flat vector.
 
@@ -109,7 +117,21 @@ def minimize(value_and_grad: Callable, params0: np.ndarray,
     ``rescore_final=False`` skips the extra evaluation that makes the
     returned score exact for the returned params — per-minibatch callers
     (the network Solver) don't want a second forward pass per batch.
+
+    Divergence handling routes through the SAME ``DL4J_NAN_GUARD`` policy
+    as the fused training pipeline (``nan_guard`` overrides the env; the
+    former ad-hoc behavior was an isfinite branch inside EpsTermination
+    that silently kept iterating on garbage): a non-finite score or
+    gradient skips that iteration's update (params unchanged — the
+    host-loop analogue of the fused path's ``lax.cond`` identity) under
+    ``skip``/``off``, additionally halves ``learning_rate`` under
+    ``halve_lr``, and raises :class:`TrainingDivergedError` naming the
+    iteration under ``raise``.
     """
+    from deeplearning4j_tpu.resilience.guard import (
+        TrainingDivergedError, nan_guard_policy)
+
+    guard = nan_guard_policy() if nan_guard is None else nan_guard
     params = np.asarray(params0, np.float64).copy()
     if score_fn is None:
         score_fn = lambda p: value_and_grad(p)[0]
@@ -134,6 +156,22 @@ def minimize(value_and_grad: Callable, params0: np.ndarray,
         grad = np.asarray(grad_j, np.float64)
         history.append(score)
         stepped = False
+        if not (np.isfinite(score) and np.isfinite(grad).all()):
+            if guard == "raise":
+                raise TrainingDivergedError(
+                    epoch=0, step=it, loss=score,
+                    where="host optimizer loop")
+            if guard == "halve_lr":
+                learning_rate *= 0.5
+                logger.warning(
+                    "minimize: non-finite score/gradient at iteration "
+                    "%d; update skipped, learning_rate halved to %g "
+                    "[DL4J_NAN_GUARD=halve_lr]", it, learning_rate)
+            else:
+                logger.warning(
+                    "minimize: non-finite score/gradient at iteration "
+                    "%d; update skipped [DL4J_NAN_GUARD=%s]", it, guard)
+            continue  # params unchanged; try the next evaluation
         dir_for_term = -grad if direction is None else direction
         if any(t.terminate(score, old_score, grad, dir_for_term)
                for t in terminations):

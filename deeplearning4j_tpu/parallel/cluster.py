@@ -21,6 +21,7 @@ ONE SPMD program and XLA collectives synchronize it — so what remains is:
 
 from __future__ import annotations
 
+import concurrent.futures
 import glob
 import hashlib
 import json
@@ -33,6 +34,7 @@ from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.parallel.statetracker import StateTracker
 from deeplearning4j_tpu.resilience import RetryError, RetryPolicy, faults
+from deeplearning4j_tpu.resilience.preemption import PreemptionGuard
 from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 from deeplearning4j_tpu.utils.fileio import atomic_write_text
 
@@ -160,6 +162,15 @@ class HeartbeatMonitor:
             timeout_s if timeout_s is not None else self.eviction_timeout_s)
 
 
+def _log_failed_save(fut: "concurrent.futures.Future") -> None:
+    if fut.cancelled():
+        return
+    exc = fut.exception()
+    if exc is not None:
+        logger.warning("background checkpoint write failed: %s", exc,
+                       exc_info=exc)
+
+
 class FaultTolerantTrainer:
     """Checkpoint/resume training loop (elastic recovery).
 
@@ -189,6 +200,10 @@ class FaultTolerantTrainer:
                  step_deadline_s: Optional[float] = None,
                  on_stall: Optional[Callable[[float], None]] = None):
         self.network = network
+        # ``network`` may be a ParallelWrapper; serialization and cursor
+        # bookkeeping always target the real model underneath, while
+        # fit/fit_epochs go through the handle the caller gave us
+        self.model = getattr(network, "network", network)
         self.dir = checkpoint_dir
         self.every = max(1, checkpoint_every)
         self.keep = max(1, keep)
@@ -197,6 +212,10 @@ class FaultTolerantTrainer:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.step_deadline_s = step_deadline_s
         self.on_stall = on_stall
+        self.preempted = False  # last fit/fit_epochs stopped on preemption
+        self._save_executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._pending_save: Optional[concurrent.futures.Future] = None
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # ------------------------------------------------------------------
@@ -223,11 +242,11 @@ class FaultTolerantTrainer:
                 h.update(chunk)
         return h.hexdigest()
 
-    def _write_manifest(self, path: str) -> None:
+    def _write_manifest(self, path: str, iteration: int) -> None:
         manifest = {
             "sha256": self._sha256(path),
             "size": os.path.getsize(path),
-            "iteration": self.network.iteration_count,
+            "iteration": iteration,
             "format": "dl4j-tpu-ckpt-manifest-v1",
         }
         atomic_write_text(self._manifest_path(path), json.dumps(manifest))
@@ -251,15 +270,17 @@ class FaultTolerantTrainer:
         return "ok"
 
     # -- save / resume -------------------------------------------------
-    def save(self) -> str:
+    def _write_checkpoint(self, model, path: str) -> str:
+        """Serialize ``model`` (live network or host snapshot) to
+        ``path`` with the full integrity ritual: tmp + rename, manifest
+        sidecar, prune, tracker pointer. Runs on the caller's thread for
+        ``save`` and on the writer thread for ``save_async``."""
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
-        faults.fault_point("checkpoint.save")
-        path = self._ckpt_path(self.network.iteration_count)
         tmp = path + ".tmp"
-        ModelSerializer.write_model(self.network, tmp, save_updater=True)
+        ModelSerializer.write_model(model, tmp, save_updater=True)
         os.replace(tmp, path)
-        self._write_manifest(path)
+        self._write_manifest(path, model.iteration_count)
         for old in self.checkpoints()[:-self.keep]:
             os.unlink(old)
             try:
@@ -269,6 +290,89 @@ class FaultTolerantTrainer:
         if self.tracker is not None:
             self.tracker.put_meta("latest_checkpoint", path)
         return path
+
+    def save(self) -> str:
+        faults.fault_point("checkpoint.save")
+        self.wait_for_saves()  # never interleave with an async write
+        return self._write_checkpoint(
+            self.model, self._ckpt_path(self.model.iteration_count))
+
+    # -- async save ----------------------------------------------------
+    def _snapshot_model(self):
+        """A frozen host-side copy of the model for the background
+        writer: same class (so ModelSerializer dispatches identically),
+        state trees gathered to host numpy ONCE — blocking only on the
+        chunk that produced them, never on the write — plus the training
+        cursors the preemption contract checkpoints. The live network is
+        free to dispatch (and donate its buffers to) the next chunk the
+        moment this returns. Only MultiLayerNetwork/ComputationGraph
+        speak this snapshot surface; other model types (TransformerLM)
+        return None and ``save_async`` degrades to a synchronous
+        ``save``."""
+        import jax
+
+        net = self.model
+        if not hasattr(net, "conf") or not hasattr(net, "updater_state"):
+            return None
+        snap = object.__new__(type(net))
+        snap.conf = net.conf
+        snap.params = jax.device_get(net.params)
+        snap.updater_state = jax.device_get(net.updater_state)
+        snap.net_state = jax.device_get(net.net_state)
+        snap.iteration_count = net.iteration_count
+        snap._initialized = True
+        if hasattr(net, "_rng"):
+            snap._rng = jax.device_get(net._rng)
+        snap._lr_scale_host = getattr(net, "_lr_scale_host", 1.0)
+        snap._epoch_cursor = getattr(net, "_epoch_cursor", 0)
+        snap._step_cursor = getattr(net, "_step_cursor", 0)
+        return snap
+
+    def save_async(self) -> "concurrent.futures.Future":
+        """``save()`` split at the device/host boundary: the device->host
+        copy happens NOW (so the bytes are immutable), the zip + manifest
+        write happens on a single background writer thread — the next
+        chunk dispatches while the previous checkpoint serializes.
+        Returns the Future of the checkpoint path; ``wait_for_saves``
+        joins it. Writes are serialized on one thread, so a slow disk
+        backs saves up instead of corrupting them."""
+        faults.fault_point("checkpoint.save")
+        snap = self._snapshot_model()
+        if snap is None:  # model type without the snapshot surface
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            try:
+                fut.set_result(self._write_checkpoint(
+                    self.model, self._ckpt_path(self.model.iteration_count)))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+            return fut
+        if self._save_executor is None:
+            self._save_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer")
+        fut = self._save_executor.submit(
+            self._write_checkpoint, snap,
+            self._ckpt_path(snap.iteration_count))
+        # a failed background write must never vanish just because a
+        # newer save superseded it before anyone joined the future
+        fut.add_done_callback(_log_failed_save)
+        self._pending_save = fut
+        return fut
+
+    def wait_for_saves(self, timeout: Optional[float] = None):
+        """Block until the in-flight async checkpoint (if any) is on
+        disk; re-raises a failed write. Returns its path or None. Also
+        retires the (non-daemon) writer thread so an idle trainer never
+        delays interpreter shutdown; the next ``save_async`` spins a
+        fresh one."""
+        fut, self._pending_save = self._pending_save, None
+        if fut is None:
+            return None
+        try:
+            return fut.result(timeout=timeout)
+        finally:
+            ex, self._save_executor = self._save_executor, None
+            if ex is not None:
+                ex.shutdown(wait=False)
 
     def _resume_candidates(self) -> List[str]:
         """Newest → oldest, with the tracker's replicated pointer appended
@@ -281,13 +385,31 @@ class FaultTolerantTrainer:
                 cands.append(meta)
         return cands
 
-    def resume(self) -> bool:
+    def resume(self, mesh=None, fsdp: bool = False) -> bool:
         """Restore the newest checkpoint that passes integrity
         verification AND loads cleanly; older checkpoints are fallbacks.
         Returns True when one was restored, False when none exists (a
         corrupt-only directory raises: silently starting from scratch
         when state was expected is the one thing recovery must not do).
-        """
+
+        Beyond the weights, resume restores the TRAINING state a
+        preemption-safe checkpoint carries: the epoch RNG key (so the
+        per-chunk key splits — and therefore every future epoch
+        permutation, re-derived via the pure ``epoch_schedule`` — continue
+        the dead run's exact stream), the host LR scale, and the
+        epoch/step cursors ``fit``/``fit_epochs`` use to skip
+        already-consumed work instead of restarting the epoch.
+
+        Elastic re-sharding: ``mesh=`` re-lays-out the restored state for
+        a DIFFERENT data-parallel width than the one the checkpoint was
+        saved at — replicated over the new mesh by default, FSDP-sharded
+        over its ``data`` axis with ``fsdp=True``. The checkpoint stores
+        full host tensors (GSPMD's sharding is a layout, not a format),
+        so any checkpoint restores onto any mesh; callers then rebuild
+        the epoch cache under the new per-shard HBM budget
+        (``build_epoch_cache(mesh=...)`` / ``ParallelWrapper``), which
+        replicates-and-streams cleanly when the batch axis no longer
+        divides the new width."""
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
         candidates = self._resume_candidates()
@@ -311,11 +433,17 @@ class FaultTolerantTrainer:
                     "to an older checkpoint", path, verdict, e)
                 saw_corrupt.append(path)
                 continue
-            net = self.network
+            net = self.model
             net.params = restored.params
             net.updater_state = restored.updater_state
             net.net_state = restored.net_state
             net.iteration_count = restored.iteration_count
+            for attr in ("_rng", "_lr_scale_host", "_epoch_cursor",
+                         "_step_cursor"):
+                if hasattr(restored, attr):
+                    setattr(net, attr, getattr(restored, attr))
+            if mesh is not None:
+                self._reshard(mesh, fsdp)
             if saw_corrupt:
                 logger.warning("resumed from fallback %s (skipped %d bad "
                                "checkpoint(s))", path, len(saw_corrupt))
@@ -327,14 +455,44 @@ class FaultTolerantTrainer:
                 f"from scratch (newest: {saw_corrupt[0]})")
         return False
 
+    def _reshard(self, mesh, fsdp: bool) -> None:
+        """Place the restored state on ``mesh``: replicated (the layout
+        the fused SPMD programs pin) or FSDP-sharded over ``data``."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        net = self.model
+        repl = NamedSharding(mesh, P())
+        if fsdp:
+            from deeplearning4j_tpu.parallel.fsdp import shard_tree
+
+            net.params = shard_tree(net.params, mesh)
+            net.updater_state = shard_tree(net.updater_state, mesh)
+        else:
+            net.params = jax.device_put(net.params, repl)
+            net.updater_state = jax.device_put(net.updater_state, repl)
+        net.net_state = jax.device_put(net.net_state, repl)
+
     # ------------------------------------------------------------------
     def fit(self, data, num_epochs: int = 1,
-            on_iteration: Optional[Callable[[int], None]] = None):
+            on_iteration: Optional[Callable[[int], None]] = None,
+            preemption: Optional[PreemptionGuard] = None):
         """Epoch loop with periodic checkpointing + heartbeats. With
         ``step_deadline_s`` set, a :class:`StepWatchdog` flags steps that
         hang past the deadline (``on_stall`` picks the policy: log /
-        evict / abort — default logs)."""
+        evict / abort — default logs).
+
+        Preemption + mid-epoch resume: pass (or default-construct via
+        ``preemption=PreemptionGuard()``) a guard and the loop polls it
+        per batch — on request it checkpoints synchronously and returns
+        with ``self.preempted = True``. Every checkpoint records the
+        STEP cursor (batches consumed in the in-progress epoch), and a
+        resumed run skips exactly that many leading batches instead of
+        restarting the epoch — with a deterministic iterator order this
+        continues the epoch where the dead process stopped."""
         net = self.network
+        model = self.model
+        self.preempted = False
         monitor = None
         watchdog = None
         if self.tracker is not None:
@@ -344,23 +502,111 @@ class FaultTolerantTrainer:
         if self.step_deadline_s is not None:
             watchdog = StepWatchdog(self.step_deadline_s,
                                     on_stall=self.on_stall).start()
+        # a checkpoint taken mid-epoch stored how many batches of the
+        # in-progress epoch were already consumed; skip exactly those
+        skip = int(getattr(model, "_step_cursor", 0) or 0)
         try:
+            if preemption is not None:
+                preemption.install()
             for _ in range(num_epochs):
                 if hasattr(data, "reset"):
                     data.reset()
                 batches = [data] if not hasattr(data, "__iter__") else data
-                for ds in batches:
+                for step_idx, ds in enumerate(batches):
+                    if skip:
+                        skip -= 1
+                        continue
                     net.fit(ds)
+                    model._step_cursor = step_idx + 1
                     if watchdog is not None:
                         watchdog.beat()
-                    if net.iteration_count % self.every == 0:
+                    if model.iteration_count % self.every == 0:
                         self.save()
                     if on_iteration is not None:
-                        on_iteration(net.iteration_count)
+                        on_iteration(model.iteration_count)
+                    if preemption is not None and preemption.check():
+                        self.save()
+                        self.preempted = True
+                        return self
+                model._step_cursor = 0
             self.save()
         finally:
+            if preemption is not None:
+                preemption.uninstall()
             if watchdog is not None:
                 watchdog.stop()
             if monitor is not None:
                 monitor.stop()
         return self
+
+    def fit_epochs(self, data, num_epochs: int, *,
+                   chunk_epochs: Optional[int] = 1,
+                   save_every_chunks: int = 1,
+                   preemption: Optional[PreemptionGuard] = None,
+                   **fit_kw):
+        """Preemption-safe fused training: ``network.fit_epochs`` with a
+        chunk-boundary hook that (a) checkpoints asynchronously every
+        ``save_every_chunks`` chunks — device->host copy now, zip write
+        on the background writer, the next chunk dispatching immediately
+        — and (b) polls the :class:`PreemptionGuard` (SIGTERM or an
+        injected ``preempt.chunk`` fault): on request it takes one final
+        SYNCHRONOUS verified checkpoint and stops cleanly with
+        ``self.preempted = True``.
+
+        The resume contract is bitwise: the checkpoint carries the epoch
+        RNG key and the epoch cursor, the per-chunk key splits are a pure
+        function of the key, and every epoch's permutation re-derives
+        from its key inside the program — so ``resume()`` followed by the
+        SAME ``fit_epochs`` call trains the remaining epochs on exactly
+        the key stream the uninterrupted run would have used, landing on
+        identical final params (identical to the last ulp across a
+        device-count change too, up to the gradient all-reduce's
+        summation order — see docs/resilience.md). Returns the loss
+        history of the epochs run in THIS process (None if none
+        remained)."""
+        net = self.network
+        model = self.model
+        self.preempted = False
+        guard = preemption or PreemptionGuard()
+        start = int(getattr(model, "_epoch_cursor", 0) or 0)
+        if start >= num_epochs:
+            logger.info("fit_epochs: checkpoint cursor already at epoch "
+                        "%d of %d — nothing to do", start, num_epochs)
+            return None
+        model._epoch_cursor = start
+        model._step_cursor = 0
+        chunks = {"n": 0}
+
+        def on_chunk(done: int) -> bool:
+            # the trainer owns the ABSOLUTE cursor (done is relative to
+            # this process's run); chunk boundaries are epoch-aligned
+            model._epoch_cursor = start + done
+            model._step_cursor = 0
+            chunks["n"] += 1
+            if guard.check():
+                # final checkpoint must be ON DISK and verified before
+                # we report a clean stop — synchronous by design
+                self.save()
+                self.preempted = True
+                return True
+            if chunks["n"] % max(1, save_every_chunks) == 0:
+                self.save_async()
+            return False
+
+        with guard:
+            hist = net.fit_epochs(data, num_epochs - start,
+                                  chunk_epochs=chunk_epochs,
+                                  on_chunk=on_chunk, **fit_kw)
+            self.wait_for_saves()
+            if not self.preempted:
+                # fallback paths (streaming / per-step) never fire
+                # on_chunk; a completed run is complete either way
+                model._epoch_cursor = num_epochs
+                self.save()
+                # the CHECKPOINT keeps cursor=num_epochs so a crash-
+                # restart loop that re-runs this job is idempotent
+                # (resume -> nothing left -> no retraining); the LIVE
+                # model resets so another interactive fit_epochs call
+                # trains again instead of silently no-oping
+                model._epoch_cursor = 0
+        return hist

@@ -222,12 +222,15 @@ class ParallelWrapper:
         return self.network.build_epoch_cache(
             data, mesh=self.mesh, accum_steps=accum_steps)
 
-    def _epoch_program(self, shuffle: bool, accum_steps: int):
+    def _epoch_program(self, shuffle: bool, accum_steps: int,
+                       guard: bool = False):
         """The network's pure chunk program jitted for SPMD execution:
         out_shardings pinned so donated params/updater state STAY
         replicated (or FSDP-sharded) across chunks instead of whatever
-        the partitioner would pick."""
-        key = (shuffle, accum_steps)
+        the partitioner would pick. With the numeric sentinel compiled in
+        (``guard``) the program returns a fifth output — the ``[E, N]``
+        trip history — replicated like the loss history."""
+        key = (shuffle, accum_steps, guard)
         fn = self._epoch_steps.get(key)
         if fn is None:
             repl = NamedSharding(self.mesh, P())
@@ -236,7 +239,10 @@ class ParallelWrapper:
                        repl, repl)
             else:
                 out = (repl, repl, repl, repl)
-            fn = jax.jit(self.network._epoch_run_fn(shuffle, accum_steps),
+            if guard:
+                out = out + (repl,)
+            fn = jax.jit(self.network._epoch_run_fn(shuffle, accum_steps,
+                                                    guard),
                          donate_argnums=(0, 1, 2) if self._donate else (),
                          out_shardings=out)
             self._epoch_steps[key] = fn
@@ -244,7 +250,8 @@ class ParallelWrapper:
 
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
-                   accum_steps: Optional[int] = None):
+                   accum_steps: Optional[int] = None,
+                   guard: Optional[str] = None, on_chunk=None):
         """``fit_epochs`` as ONE donated SPMD program per epoch chunk:
         E epochs x N batches of `lax.scan` with the batch axis sharded
         over the mesh ``data`` axis, params/updater replicated (or
@@ -263,6 +270,7 @@ class ParallelWrapper:
             DeviceDataSetCache, DeviceMultiDataSetCache,
             accum_steps_default, drive_epoch_chunks, effective_accum_steps,
             stream_epochs)
+        from deeplearning4j_tpu.resilience.guard import nan_guard_policy
 
         net = self.network
         net._ensure_init()
@@ -310,29 +318,71 @@ class ParallelWrapper:
             return None
         accum = effective_accum_steps(accum_steps, cache.batch)
         multi = isinstance(cache, DeviceMultiDataSetCache)
-        step = self._epoch_program(shuffle, accum)
+        guard = nan_guard_policy() if guard is None else guard
+        guarded = guard != "off"
+        step = self._epoch_program(shuffle, accum, guarded)
 
         def launch(epoch_keys):
             with self.mesh:
                 if multi:
-                    (net.params, net.updater_state, net.net_state,
-                     hist) = step(
+                    out = step(
                         net.params, net.updater_state, net.net_state,
                         jnp.asarray(net.iteration_count, jnp.int32),
+                        jnp.asarray(net._lr_scale_host, jnp.float32),
                         cache.features, cache.labels, cache.features_masks,
                         cache.labels_masks, epoch_keys)
                 else:
-                    (net.params, net.updater_state, net.net_state,
-                     hist) = step(
+                    out = step(
                         net.params, net.updater_state, net.net_state,
                         jnp.asarray(net.iteration_count, jnp.int32),
                         jnp.asarray(net._lr_scale_host, jnp.float32),
                         cache.features, cache.labels, cache.features_mask,
                         cache.labels_mask, epoch_keys)
-            return hist
+            if guarded:
+                (net.params, net.updater_state, net.net_state,
+                 hist, trips) = out
+                return hist, trips
+            (net.params, net.updater_state, net.net_state, hist) = out
+            return hist, None
+
+        def replay_step(params, upd, nst, it, i, rng):
+            # DL4J_NAN_GUARD=raise localization replays through the
+            # network's own per-step math — accumulation split included
+            # (same per-microbatch rng stream as the fused run) — on the
+            # replicated layout; fine as a pre-raise diagnostic even
+            # under FSDP, where it temporarily re-replicates the state
+            # it is about to abort with
+            with self.mesh:
+                if multi:
+                    args = (params, upd, nst, jnp.asarray(it, jnp.int32),
+                            tuple(x[i] for x in cache.features),
+                            tuple(y[i] for y in cache.labels),
+                            None if cache.features_masks is None
+                            else tuple(m[i] for m in cache.features_masks),
+                            tuple(m[i] for m in cache.labels_masks), rng)
+                    if accum > 1:
+                        p, u, s, loss, _ = net._accum_step_impl(*args,
+                                                                accum)
+                    else:
+                        p, u, s, loss, _ = net._train_step(*args, None)
+                else:
+                    args = (params, upd, nst, jnp.asarray(it, jnp.int32),
+                            jnp.asarray(net._lr_scale_host, jnp.float32),
+                            cache.features[i], cache.labels[i],
+                            None if cache.features_mask is None
+                            else cache.features_mask[i],
+                            cache.labels_mask[i], rng)
+                    if accum > 1:
+                        p, u, s, _, loss = net._accum_step_impl(*args,
+                                                                accum)
+                    else:
+                        p, u, s, _, loss = net._train_step(*args, None)
+            return p, u, s, loss
 
         return drive_epoch_chunks(net, cache, num_epochs, chunk_epochs,
-                                  launch)
+                                  launch, shuffle=shuffle, guard=guard,
+                                  replay_step=replay_step,
+                                  on_chunk=on_chunk)
 
     def output(self, x):
         x = np.asarray(x)

@@ -439,36 +439,200 @@ class DeviceMultiDataSetCache:
                    nbytes=nbytes, mesh=mesh, n_shard=n_shard)
 
 
+def chunk_deadline_s(chunk_steps: int) -> float:
+    """StepWatchdog deadline for one fused chunk dispatch, scaled by the
+    number of fused optimizer steps it contains. ``DL4J_STEP_DEADLINE_S``
+    sets the per-step budget exactly (tests use tiny values); unset, a
+    generous 30 s/step floored at 120 s — the first dispatch includes the
+    chunk program's XLA compile, which under remote compile can take
+    minutes on its own."""
+    raw = os.environ.get("DL4J_STEP_DEADLINE_S", "")
+    steps = max(1, int(chunk_steps))
+    try:
+        if raw:
+            return float(raw) * steps
+    except ValueError:
+        pass
+    return max(120.0, 30.0 * steps)
+
+
 def drive_epoch_chunks(net, cache, num_epochs: int,
-                       chunk_epochs: Optional[int], launch_chunk):
+                       chunk_epochs: Optional[int], launch_chunk, *,
+                       shuffle: bool = True, guard: str = "off",
+                       replay_step=None, on_chunk=None):
     """The shared host-side chunk driver behind both classes' fit_epochs:
     splits the net's RNG into per-chunk epoch keys, launches each fused
-    chunk (``launch_chunk(epoch_keys) -> [k, N] hist`` updates the net's
-    params/updater/net state itself), advances the iteration count by
-    k*N, and fires listeners once per chunk — the host decision point.
-    Default chunking: whole run without listeners, one epoch with them.
-    Returns the concatenated ``[E, N]`` loss history."""
+    chunk (``launch_chunk(epoch_keys) -> ([k, N] hist, [k, N] trips or
+    None)`` updates the net's params/updater/net state itself), advances
+    the iteration count by k*N, and fires listeners once per chunk — the
+    host decision point. Default chunking: whole run without listeners,
+    one epoch with them. Returns the concatenated ``[E, N]`` loss
+    history.
+
+    Self-healing hooks (the robustness layer around the fast path):
+
+    - every chunk dispatch runs under a :class:`StepWatchdog` whose
+      deadline scales with the chunk's step count (``chunk_deadline_s``)
+      — a hung XLA dispatch is logged as a stall, not a silent wedge —
+      and declares the ``epoch.chunk`` fault site for chaos tests;
+    - ``guard`` is the resolved ``DL4J_NAN_GUARD`` policy. When the
+      chunk program carries the numeric sentinel (``trips`` not None)
+      the full boolean history lands in ``net._last_sentinel``
+      (``[E, N]``, True = tripped/skipped step) and trips are enforced
+      via ``_enforce_nan_guard`` (log / halve ``net._lr_scale_host`` /
+      replay-localize + raise ``TrainingDivergedError``). ``halve_lr``
+      and ``raise`` must act between chunks, so they read the history
+      per chunk — one host sync each, blocking on that chunk's
+      completion; ``skip`` takes no per-chunk action, so its read (and
+      its warning) defers to end-of-run and chunk dispatches stay
+      pipelined exactly like the unguarded path. Under ``raise`` the
+      state is snapshotted before each launch (the chunk program
+      donates its inputs) so ``replay_step(params, upd, nst, iteration,
+      batch_index, rng) -> (params, upd, nst, loss)`` can re-run the
+      chunk per-step from the last-good state;
+    - ``on_chunk(epochs_done) -> bool`` fires after listeners;
+      returning True stops the run at this chunk boundary (the
+      preemption-safe checkpoint hook — ``FaultTolerantTrainer`` sets
+      the absolute epoch cursor, saves, and polls its
+      ``PreemptionGuard`` here).
+    """
     import jax
     import jax.numpy as jnp
+
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 
     if chunk_epochs is None:
         chunk_epochs = 1 if net.listeners else num_epochs
     chunk_epochs = max(1, min(int(chunk_epochs), num_epochs))
     history = []
+    sentinel_chunks = []
+    net._last_sentinel = None
+    # skip takes no per-chunk action — keep its trip reads off the hot
+    # path (device arrays accumulate; one sync at end of run)
+    defer_inspect = guard not in ("halve_lr", "raise")
     done = 0
-    while done < num_epochs:
-        k = min(chunk_epochs, num_epochs - done)
-        keys = jax.random.split(net._rng, k + 1)
-        net._rng = keys[0]
-        hist = launch_chunk(keys[1:])
-        net._train_dispatches += 1
-        net.iteration_count += k * cache.n_batches
-        net._score = hist[-1, -1]  # device scalar; no per-chunk sync
-        history.append(hist)
-        done += k
-        for listener in net.listeners:
-            listener.iteration_done(net, net.iteration_count)
+    watchdog = StepWatchdog(
+        chunk_deadline_s(chunk_epochs * cache.n_batches))
+    net._chunk_watchdog = watchdog  # introspection (tests, metrics)
+    try:
+        with watchdog:
+            while done < num_epochs:
+                k = min(chunk_epochs, num_epochs - done)
+                faults.fault_point("epoch.chunk")
+                keys = jax.random.split(net._rng, k + 1)
+                net._rng = keys[0]
+                snapshot = None
+                it0 = net.iteration_count
+                if guard == "raise":
+                    # launch donates params/updater/net state; keep the
+                    # last-good copy so a trip can be replayed per-step
+                    snapshot = tuple(
+                        jax.tree_util.tree_map(jnp.copy, t)
+                        for t in (net.params, net.updater_state,
+                                  net.net_state))
+                hist, trips = launch_chunk(keys[1:])
+                watchdog.beat()
+                net._train_dispatches += 1
+                net.iteration_count += k * cache.n_batches
+                net._score = hist[-1, -1]  # device scalar
+                if trips is not None:
+                    if defer_inspect:
+                        sentinel_chunks.append(trips)  # device; no sync
+                    else:
+                        # halve_lr/raise act between chunks: this read
+                        # blocks on the chunk's completion — the one
+                        # host sync those policies cost per chunk
+                        t = np.asarray(trips)
+                        sentinel_chunks.append(t)
+                        if t.any():
+                            _enforce_nan_guard(net, guard, t, done,
+                                               keys[1:], shuffle,
+                                               cache.n_batches, snapshot,
+                                               it0, replay_step)
+                history.append(hist)
+                done += k
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration_count)
+                if on_chunk is not None and on_chunk(done):
+                    break
+    finally:
+        # flush even when the raise policy aborts the run mid-chunk: a
+        # TrainingDivergedError handler reads the history that tripped it
+        if sentinel_chunks:
+            full = np.concatenate([np.asarray(t)
+                                   for t in sentinel_chunks])
+            net._last_sentinel = full
+            if defer_inspect and full.any():
+                # the deferred skip-policy report (epoch indices are
+                # absolute: the history covers the run from epoch 0)
+                _enforce_nan_guard(net, guard, full, 0, None, shuffle,
+                                   cache.n_batches, None, 0, None)
     return history[0] if len(history) == 1 else jnp.concatenate(history)
+
+
+def _enforce_nan_guard(net, policy: str, trips: np.ndarray,
+                       done_epochs: int, chunk_keys, shuffle: bool,
+                       n_batches: int, snapshot, it0: int,
+                       replay_step) -> None:
+    """Host-side policy for a chunk whose sentinel tripped. ``trips`` is
+    the chunk's ``[k, N]`` boolean history (True = the in-program guard
+    skipped that step)."""
+    from deeplearning4j_tpu.resilience.guard import TrainingDivergedError
+
+    log = logging.getLogger(__name__)
+    n_trips = int(trips.sum())
+    e_rel, step = (int(v) for v in np.argwhere(trips)[0])
+    epoch = done_epochs + e_rel
+    if policy == "halve_lr":
+        net._lr_scale_host = getattr(net, "_lr_scale_host", 1.0) * 0.5
+        log.warning(
+            "numeric sentinel: %d non-finite step(s) skipped in-program "
+            "(first at epoch %d, step %d); halving host LR scale to %g "
+            "[DL4J_NAN_GUARD=halve_lr]", n_trips, epoch, step,
+            net._lr_scale_host)
+        return
+    if policy != "raise":
+        log.warning(
+            "numeric sentinel: %d non-finite step(s) skipped in-program "
+            "(first at epoch %d, step %d); params/updater state carried "
+            "unchanged through them [DL4J_NAN_GUARD=skip]", n_trips,
+            epoch, step)
+        return
+    batch_index = loss = None
+    if replay_step is not None and snapshot is not None:
+        batch_index, loss = _replay_localize(
+            replay_step, snapshot, chunk_keys, shuffle, n_batches,
+            e_rel, step, it0)
+    raise TrainingDivergedError(epoch=epoch, step=step,
+                                batch_index=batch_index, loss=loss,
+                                n_trips=n_trips)
+
+
+def _replay_localize(replay_step, snapshot, chunk_keys, shuffle: bool,
+                     n_batches: int, e_trip: int, s_trip: int, it0: int):
+    """Per-step replay from the chunk-start snapshot up to (and through)
+    the first tripped step, re-deriving each epoch's batch order and step
+    keys EAGERLY from the same pure ``epoch_schedule`` derivation the
+    fused program traced — so the replay consumes the identical RNG
+    stream and visits the identical batches. Returns ``(batch_index,
+    loss)`` of the offending step: the index into the dataset's batch
+    list (the permutation inverts host-side for free) and the non-finite
+    loss that tripped the sentinel."""
+    params, upd, nst = snapshot
+    it = it0
+    order = None
+    loss = None
+    for e in range(e_trip + 1):
+        order, step_keys = epoch_schedule(chunk_keys[e], n_batches,
+                                          shuffle)
+        order = np.asarray(order)
+        last = s_trip if e == e_trip else n_batches - 1
+        for j in range(last + 1):
+            params, upd, nst, loss = replay_step(
+                params, upd, nst, it, int(order[j]), step_keys[j])
+            it += 1
+    return int(order[s_trip]), float(loss)
 
 
 def stream_epochs(net, data, num_epochs: int) -> None:

@@ -14,6 +14,13 @@ package instead of hand-rolling sleeps and bare ``except`` clauses:
 - :mod:`~deeplearning4j_tpu.resilience.watchdog` — ``StepWatchdog`` flags
   hung training steps past a deadline (the slow/hung-host detector SPMD
   needs, since a blocked collective never crashes).
+- :mod:`~deeplearning4j_tpu.resilience.guard` — the ``DL4J_NAN_GUARD``
+  divergence policy behind the fused pipeline's in-program numeric
+  sentinel (``skip``/``halve_lr``/``raise``/``off``) and
+  :class:`TrainingDivergedError`.
+- :mod:`~deeplearning4j_tpu.resilience.preemption` — ``PreemptionGuard``
+  latches SIGTERM / injected ``preempt.chunk`` faults so fused training
+  checkpoints and stops at a chunk boundary instead of dying mid-run.
 
 Checkpoint integrity verification lives with its writer
 (``parallel.cluster.FaultTolerantTrainer``): sha256 manifest sidecars on
@@ -35,6 +42,14 @@ from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
     install_from_env,
     parse_spec,
     uninstall,
+)
+from deeplearning4j_tpu.resilience.guard import (  # noqa: F401
+    TrainingDivergedError,
+    nan_guard_policy,
+    tree_all_finite,
+)
+from deeplearning4j_tpu.resilience.preemption import (  # noqa: F401
+    PreemptionGuard,
 )
 from deeplearning4j_tpu.resilience.retry import (  # noqa: F401
     RetryError,

@@ -23,12 +23,17 @@ Usage (process-level, e.g. chaos runs of the CLI)::
 Well-known sites (grep for ``fault_point(`` for the authoritative list):
 
 - ``statetracker.write``   — every FileStateTracker atomic publish
-- ``checkpoint.save``      — FaultTolerantTrainer.save, before the write
+- ``checkpoint.save``      — FaultTolerantTrainer.save/save_async, before
+  the write
 - ``checkpoint.restore``   — FaultTolerantTrainer.resume, per candidate
 - ``heartbeat.post``       — every heartbeat post (monitor + workers)
 - ``distributed.init``     — each jax.distributed.initialize attempt
 - ``fetcher.download``     — each dataset download attempt
 - ``registry.retrieve``    — ConfigRegistry reads (wait_for polls)
+- ``epoch.chunk``          — before every fused epoch-chunk dispatch
+  (drive_epoch_chunks)
+- ``preempt.chunk``        — polled at every chunk boundary by
+  PreemptionGuard.check; an injected fault here IS a preemption notice
 
 Schedules are deterministic: ``fail_nth`` counts invocations,
 ``fail_rate`` draws from its own seeded RNG — re-running a test replays

@@ -1,0 +1,108 @@
+"""In-program numeric sentinel + ``DL4J_NAN_GUARD`` divergence policy.
+
+The fused epoch pipeline (perf/epoch_cache.py) runs E epochs x N optimizer
+steps as ONE XLA program — by the time the host sees the loss history, a
+single non-finite microbatch has already poisoned every subsequent step of
+the chunk. The per-step paths could react on host (and
+``optimize/function.py`` did, with an ad-hoc branch); the fused path needs
+the reaction IN the program.
+
+The sentinel is a per-step finite check on the loss and on every gradient
+leaf (a non-finite global grad-norm <=> some non-finite gradient element;
+checking leaves directly avoids the f32 overflow a naive sum-of-squares
+norm would add on healthy-but-large gradients). A tripped step applies a
+``lax.cond``-guarded identity — params, updater state and net state carry
+through unchanged, so one poisoned batch costs exactly one skipped update —
+and the ``[E, N]`` trip history returns with the loss history for the host
+to enforce the policy per chunk:
+
+- ``skip`` (default) — log and continue; the in-program identity already
+  contained the damage.
+- ``halve_lr`` — additionally halve the host LR scale for subsequent
+  chunks (divergence is often a too-hot schedule, not bad data).
+- ``raise`` — replay the chunk per-step from the last-good snapshot to
+  localize the offending batch, then raise :class:`TrainingDivergedError`
+  naming the exact epoch/step/batch.
+- ``off`` — compile the fused program without the guard (the pre-sentinel
+  behavior; the bench's overhead baseline).
+
+A skipped step still advances the in-program iteration counter, so LR
+schedules stay aligned with an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "NAN_GUARD_POLICIES",
+    "TrainingDivergedError",
+    "nan_guard_policy",
+    "tree_all_finite",
+]
+
+NAN_GUARD_POLICIES = ("skip", "halve_lr", "raise", "off")
+DEFAULT_POLICY = "skip"
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised under ``DL4J_NAN_GUARD=raise`` when a fused (or host-side)
+    optimizer step produces a non-finite loss or gradient.
+
+    Carries the exact location: ``epoch``/``step`` index the sentinel
+    tripped at (step = position in that epoch's batch order), plus —
+    when the per-step replay could localize it — the ``batch_index``
+    into the dataset's batch list and the offending ``loss`` value."""
+
+    def __init__(self, epoch: int, step: int, batch_index=None, loss=None,
+                 n_trips: int = 1, where: str = "fused epoch program"):
+        self.epoch = int(epoch)
+        self.step = int(step)
+        self.batch_index = batch_index
+        self.loss = loss
+        self.n_trips = int(n_trips)
+        msg = (f"training diverged in the {where}: non-finite step at "
+               f"epoch {epoch}, step {step}")
+        if batch_index is not None:
+            msg += f" (dataset batch #{batch_index}"
+            if loss is not None:
+                msg += f", loss={loss}"
+            msg += ")"
+        if n_trips > 1:
+            msg += f"; {n_trips} step(s) tripped in total"
+        msg += " [DL4J_NAN_GUARD=raise]"
+        super().__init__(msg)
+
+
+def nan_guard_policy() -> str:
+    """Resolve ``DL4J_NAN_GUARD`` (default ``skip``). Unknown values log
+    once and fall back to the default rather than killing a training run
+    over a typo'd env var."""
+    raw = os.environ.get("DL4J_NAN_GUARD", "").strip().lower()
+    if not raw:
+        return DEFAULT_POLICY
+    if raw not in NAN_GUARD_POLICIES:
+        logger.warning("DL4J_NAN_GUARD=%r is not one of %s; using %r",
+                       raw, NAN_GUARD_POLICIES, DEFAULT_POLICY)
+        return DEFAULT_POLICY
+    return raw
+
+
+def tree_all_finite(tree):
+    """Traced scalar bool: every leaf of ``tree`` is everywhere finite.
+    Integer leaves (updater step counters) are vacuously finite and
+    skipped, so the check is O(float params) elementwise — cheap next to
+    the forward+backward that produced the gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    checks = [jnp.all(jnp.isfinite(leaf))
+              for leaf in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)]
+    if not checks:
+        return jnp.bool_(True)
+    return functools.reduce(jnp.logical_and, checks)

@@ -184,6 +184,26 @@ class ModelSerializer:
             iteration = model.iteration_count
         else:
             raise TypeError(f"cannot serialize {type(model).__name__}")
+        meta = {
+            "format_version": ModelSerializer.FORMAT_VERSION,
+            "model_type": mtype,
+            "iteration_count": iteration,
+        }
+        # training_state: everything a mid-run resume needs beyond the
+        # weights — the epoch RNG key (the per-chunk key splits and the
+        # per-epoch permutations are a pure function of it, so a restored
+        # key reproduces the uninterrupted run's exact stream), the host
+        # LR scale (SCORE policy / halve_lr guard), and the epoch/step
+        # cursors a preemption-safe checkpoint was taken at. Absent on
+        # pre-v2 archives and on model types without an RNG stream.
+        if hasattr(model, "_rng"):
+            meta["training_state"] = {
+                "rng_key": np.asarray(model._rng).tolist(),
+                "lr_scale_host": float(getattr(model, "_lr_scale_host",
+                                               1.0)),
+                "epoch_cursor": int(getattr(model, "_epoch_cursor", 0)),
+                "step_cursor": int(getattr(model, "_step_cursor", 0)),
+            }
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("configuration.json", conf_json)
             _write_npz(zf, "coefficients.npz", model.params)
@@ -191,14 +211,7 @@ class ModelSerializer:
                 _write_npz(zf, "updater.npz", updater_tree)
             if net_state is not None:
                 _write_npz(zf, "state.npz", net_state)
-            zf.writestr(
-                "metadata.json",
-                json.dumps({
-                    "format_version": ModelSerializer.FORMAT_VERSION,
-                    "model_type": mtype,
-                    "iteration_count": iteration,
-                }),
-            )
+            zf.writestr("metadata.json", json.dumps(meta))
 
     @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
@@ -267,4 +280,11 @@ class ModelSerializer:
             if "state.npz" in zf.namelist():
                 net.net_state = _merge_into(net.net_state, _read_npz(zf, "state.npz"))
             net.iteration_count = meta.get("iteration_count", 0)
+            ts = meta.get("training_state")
+            if ts:
+                net._rng = jnp.asarray(np.asarray(ts["rng_key"],
+                                                  np.uint32))
+                net._lr_scale_host = float(ts.get("lr_scale_host", 1.0))
+                net._epoch_cursor = int(ts.get("epoch_cursor", 0))
+                net._step_cursor = int(ts.get("step_cursor", 0))
         return net

@@ -15,9 +15,14 @@
 #                                      host mesh: SPMD fit_epochs vs
 #                                      single-device, parameter averaging
 #                                      vs all-reduce, accumulation)
-# The eval/epoch/dp equivalence tests are part of the default tier-1 run;
-# --eval/--epoch/--dp are the narrow fast paths for iterating on those
-# surfaces.
+#        scripts/verify.sh --heal     (just the self-healing suite +
+#                                      existing chaos cases: NaN-guard
+#                                      policies, preemption + bitwise
+#                                      elastic resume, save_async,
+#                                      checkpoint corruption/eviction)
+# The eval/epoch/dp/heal tests are part of the default tier-1 run;
+# --eval/--epoch/--dp/--heal are the narrow fast paths for iterating on
+# those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -32,6 +37,9 @@ elif [ "${1:-}" = "--epoch" ]; then
 elif [ "${1:-}" = "--dp" ]; then
     shift
     TARGET="tests/test_dp_epoch.py tests/test_parallel.py"
+elif [ "${1:-}" = "--heal" ]; then
+    shift
+    TARGET="tests/test_self_healing.py tests/test_resilience.py tests/test_cluster.py"
 fi
 
 rm -f /tmp/_t1.log

@@ -302,7 +302,7 @@ class TestDispatchAndChunking:
         shape must add exactly one entry."""
         net = _ff_net()
         net.fit_epochs(ListDataSetIterator(_ff_data(100, seed=0), 32), 2)
-        step = net._epoch_steps[(True, 1)]
+        step = net._epoch_steps[(True, 1, True)]
         assert step._cache_size() == 1
         net.fit_epochs(ListDataSetIterator(_ff_data(100, seed=7), 32), 2)
         assert step._cache_size() == 1  # same shapes: no new compile

@@ -1,0 +1,600 @@
+"""Self-healing fused training: in-program divergence guards,
+preemption-safe mid-epoch checkpoints, elastic resume.
+
+The contract under test (resilience/guard.py + resilience/preemption.py +
+drive_epoch_chunks' enforcement + FaultTolerantTrainer.save_async/
+fit_epochs/resume):
+
+- the numeric sentinel skips a poisoned step IN-PROGRAM (params/updater
+  carried unchanged — one NaN batch costs one update, not E*N), records
+  the exact ``[E, N]`` trip history, and the host enforces
+  ``DL4J_NAN_GUARD``: ``skip`` logs, ``halve_lr`` halves the host LR
+  scale per tripped chunk, ``raise`` replays per-step from the last-good
+  snapshot and names the exact epoch/step/batch;
+- a mid-run preemption (injected at ``preempt.chunk``) checkpoints at the
+  chunk boundary, and resume + the remaining epochs reproduce the
+  uninterrupted run's final params BITWISE (the per-chunk key splits are
+  a pure function of the restored RNG key) — FF/RNN/graph, fsdp on/off;
+- resuming onto a DIFFERENT device count re-shards the restored state and
+  matches to <=1e-6 (only the gradient all-reduce's summation order
+  differs across widths); an indivisible width replicates-and-streams;
+- ``save_async`` hides the zip write behind the next dispatch and still
+  produces a verified manifest; the checkpoint round-trips the training
+  state (RNG key, LR scale, cursors);
+- the per-step FaultTolerantTrainer.fit records a step cursor so a
+  mid-epoch resume skips exactly the consumed batches;
+- ``optimize.function.minimize`` routes non-finite scores through the
+  same policy instead of its old ad-hoc branch;
+- AsyncDataSetIterator producer failures carry the originating batch
+  index into the epoch-cache drain.
+"""
+
+import logging
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel import ParallelWrapper, build_mesh
+from deeplearning4j_tpu.parallel.cluster import FaultTolerantTrainer
+from deeplearning4j_tpu.resilience import (
+    PreemptionGuard,
+    TrainingDivergedError,
+    fail_nth,
+    inject,
+)
+
+TOL = dict(rtol=0, atol=1e-6)
+
+
+def _ff_net(seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+        .updater(Updater.ADAM).list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _sgd_net(seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+        .updater(Updater.SGD).list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _rnn_net(seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.02)
+        .updater(Updater.SGD).list()
+        .layer(0, L.GravesLSTM(n_in=3, n_out=4, activation="tanh"))
+        .layer(1, L.RnnOutputLayer(n_in=4, n_out=4,
+                                   loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph_net(seed=7):
+    g = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+        .updater(Updater.ADAM)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("dense", L.DenseLayer(n_in=6, n_out=8,
+                                         activation="tanh"), "in")
+        .add_layer("out", L.OutputLayer(n_in=8, n_out=3), "dense")
+        .set_outputs("out")
+    )
+    return ComputationGraph(g.build()).init()
+
+
+def _ff_data(n=64, seed=0, poison_row=None):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    if poison_row is not None:
+        x[poison_row] = np.nan
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _rnn_data(n=16, t=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, t, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (n, t))]
+    lm = (np.arange(t)[None, :]
+          < rng.integers(3, t + 1, n)[:, None]).astype(np.float32)
+    return DataSet(x, y, None, lm)
+
+
+def _assert_trees(a, b, bitwise=True):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if bitwise:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), **TOL)
+
+
+# ---------------------------------------------------------------------------
+# numeric sentinel + DL4J_NAN_GUARD
+# ---------------------------------------------------------------------------
+
+
+class TestNanGuard:
+    # batch 16 rows; row 20 poisoned -> dataset batch #1 trips, every epoch
+
+    def test_guard_off_vs_skip_bitwise_on_clean_data(self):
+        a, b = _ff_net(), _ff_net()
+        it = lambda: ListDataSetIterator(_ff_data(), 16)
+        ha = a.fit_epochs(it(), 3, guard="off")
+        hb = b.fit_epochs(it(), 3, guard="skip")
+        np.testing.assert_array_equal(np.asarray(ha), np.asarray(hb))
+        _assert_trees(a.params, b.params)
+        assert a._last_sentinel is None
+        assert b._last_sentinel is not None and not b._last_sentinel.any()
+        assert b._last_sentinel.shape == (3, 4)
+
+    def test_skip_contains_poison_to_one_step(self):
+        """One poisoned batch = exactly one skipped update per epoch:
+        the guarded run on [b0, BAD, b1, b2] equals a per-step run that
+        trains every batch except the poisoned one (plain SGD, constant
+        LR, no dropout -> updates depend only on data and params)."""
+        guarded = _sgd_net()
+        hist = guarded.fit_epochs(
+            ListDataSetIterator(_ff_data(poison_row=20), 16), 1,
+            shuffle=False, guard="skip")
+        assert guarded._last_sentinel.tolist() == [[False, True, False,
+                                                    False]]
+        assert not np.isfinite(np.asarray(hist)[0, 1])
+        clean = _sgd_net()
+        batches = list(ListDataSetIterator(_ff_data(), 16))
+        for i in (0, 2, 3):
+            clean.fit(batches[i])
+        _assert_trees(guarded.params, clean.params)
+
+    def test_sentinel_history_marks_every_epoch(self):
+        net = _ff_net()
+        net.fit_epochs(ListDataSetIterator(_ff_data(poison_row=20), 16),
+                       3, shuffle=False, guard="skip")
+        assert net._last_sentinel.shape == (3, 4)
+        np.testing.assert_array_equal(
+            np.argwhere(net._last_sentinel),
+            [[0, 1], [1, 1], [2, 1]])
+
+    def test_halve_lr_halves_per_tripped_chunk(self):
+        net = _ff_net()
+        net.fit_epochs(ListDataSetIterator(_ff_data(poison_row=20), 16),
+                       2, shuffle=False, guard="halve_lr", chunk_epochs=1)
+        assert net._lr_scale_host == pytest.approx(0.25)
+
+    def test_raise_names_epoch_step_and_batch(self):
+        net = _ff_net()
+        with pytest.raises(TrainingDivergedError) as ei:
+            net.fit_epochs(
+                ListDataSetIterator(_ff_data(poison_row=20), 16), 1,
+                shuffle=False, guard="raise")
+        e = ei.value
+        assert (e.epoch, e.step, e.batch_index) == (0, 1, 1)
+        assert not np.isfinite(e.loss)
+        assert "epoch 0, step 1" in str(e)
+        # the trip history that caused the raise is still readable by
+        # the exception handler
+        assert net._last_sentinel is not None and net._last_sentinel.any()
+
+    def test_raise_localizes_through_shuffle(self):
+        """With shuffle on, the tripped scan position differs from the
+        dataset batch index; the replay inverts the permutation."""
+        net = _ff_net(seed=3)
+        with pytest.raises(TrainingDivergedError) as ei:
+            net.fit_epochs(
+                ListDataSetIterator(_ff_data(poison_row=20), 16), 1,
+                shuffle=True, guard="raise")
+        assert ei.value.batch_index == 1  # rows 16..31 hold the NaN
+
+    def test_graph_guard_raise(self):
+        net = _graph_net()
+        with pytest.raises(TrainingDivergedError) as ei:
+            net.fit_epochs(
+                ListDataSetIterator(_ff_data(poison_row=20), 16), 1,
+                shuffle=False, guard="raise")
+        assert (ei.value.epoch, ei.value.step, ei.value.batch_index) \
+            == (0, 1, 1)
+
+    def test_graph_skip_keeps_params_finite(self):
+        net = _graph_net()
+        net.fit_epochs(ListDataSetIterator(_ff_data(poison_row=20), 16),
+                       2, shuffle=False, guard="skip")
+        assert net._last_sentinel.sum() == 2
+        for leaf in jax.tree_util.tree_leaves(net.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_wrapper_guard_skip_and_raise(self):
+        for fsdp in (False, True):
+            w = ParallelWrapper(_ff_net(), mesh=build_mesh(), fsdp=fsdp)
+            w.fit_epochs(ListDataSetIterator(_ff_data(poison_row=20), 16),
+                         2, shuffle=False, guard="skip")
+            assert w.network._last_sentinel.sum() == 2
+            for leaf in jax.tree_util.tree_leaves(w.network.params):
+                assert np.isfinite(np.asarray(leaf)).all()
+        w = ParallelWrapper(_ff_net(), mesh=build_mesh())
+        with pytest.raises(TrainingDivergedError) as ei:
+            w.fit_epochs(ListDataSetIterator(_ff_data(poison_row=20), 16),
+                         1, shuffle=False, guard="raise")
+        assert ei.value.batch_index == 1
+
+    def test_env_policy_resolution(self, monkeypatch):
+        from deeplearning4j_tpu.resilience.guard import nan_guard_policy
+
+        assert nan_guard_policy() == "skip"
+        monkeypatch.setenv("DL4J_NAN_GUARD", "RAISE")
+        assert nan_guard_policy() == "raise"
+        monkeypatch.setenv("DL4J_NAN_GUARD", "bogus")
+        assert nan_guard_policy() == "skip"
+
+    def test_early_stopping_masks_tripped_scores(self):
+        """A skipped step's recorded NaN loss must not fire
+        InvalidScore: the policy already handled it in-program."""
+        from deeplearning4j_tpu.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingResult, EarlyStoppingTrainer,
+            InvalidScoreIterationTerminationCondition,
+            MaxEpochsTerminationCondition)
+
+        net = _ff_net()
+        config = (EarlyStoppingConfiguration.Builder()
+                  .epoch_termination_conditions(
+                      MaxEpochsTerminationCondition(2))
+                  .iteration_termination_conditions(
+                      InvalidScoreIterationTerminationCondition())
+                  .score_calculator(DataSetLossCalculator(
+                      ListDataSetIterator(_ff_data(seed=5), 16)))
+                  .build())
+        trainer = EarlyStoppingTrainer(
+            config, net,
+            ListDataSetIterator(_ff_data(poison_row=20), 16),
+            fuse_epochs=True)
+        result = trainer.fit()
+        assert (result.termination_reason
+                == EarlyStoppingResult.TerminationReason.EPOCH_TERMINATION)
+        assert result.total_epochs == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe checkpoints + bitwise elastic resume
+# ---------------------------------------------------------------------------
+
+
+def _run_preempt_resume(make_net, data_fn, tmp_path, epochs=5,
+                        preempt_at=2, wrap=None, resume_wrap=None):
+    """Uninterrupted run vs (preempt at chunk boundary -> fresh process
+    resume -> finish); returns (baseline_model, resumed_model)."""
+    base = make_net()
+    handle = wrap(base) if wrap else base
+    handle.fit_epochs(data_fn(), epochs, chunk_epochs=1)
+
+    n2 = make_net()
+    h2 = wrap(n2) if wrap else n2
+    t2 = FaultTolerantTrainer(h2, str(tmp_path))
+    with inject("preempt.chunk", fail_nth(preempt_at)):
+        t2.fit_epochs(data_fn(), epochs, chunk_epochs=1)
+    assert t2.preempted
+    assert n2._epoch_cursor == preempt_at
+
+    n3 = make_net()
+    h3 = (resume_wrap or wrap)(n3) if (resume_wrap or wrap) else n3
+    t3 = FaultTolerantTrainer(h3, str(tmp_path))
+    assert t3.resume()
+    assert n3._epoch_cursor == preempt_at
+    if resume_wrap or wrap:
+        # re-place the restored host state on the handle's mesh
+        h3._place_params()
+    t3.fit_epochs(data_fn(), epochs, chunk_epochs=1)
+    assert not t3.preempted
+    # the final checkpoint records completion (idempotent restart); the
+    # LIVE cursor resets so further interactive fit_epochs calls train
+    assert n3._epoch_cursor == 0
+    return base, n3
+
+
+@pytest.mark.chaos
+class TestPreemptResume:
+    def test_ff_bitwise(self, tmp_path):
+        base, resumed = _run_preempt_resume(
+            _ff_net, lambda: ListDataSetIterator(_ff_data(), 16),
+            tmp_path)
+        _assert_trees(base.params, resumed.params)
+        _assert_trees(base.updater_state, resumed.updater_state)
+        assert base.iteration_count == resumed.iteration_count
+
+    def test_rnn_bitwise(self, tmp_path):
+        base, resumed = _run_preempt_resume(
+            _rnn_net, lambda: ListDataSetIterator(_rnn_data(), 8),
+            tmp_path, epochs=3)
+        _assert_trees(base.params, resumed.params)
+
+    def test_graph_bitwise(self, tmp_path):
+        base, resumed = _run_preempt_resume(
+            _graph_net, lambda: ListDataSetIterator(_ff_data(), 16),
+            tmp_path, epochs=3)
+        _assert_trees(base.params, resumed.params)
+
+    @pytest.mark.parametrize("fsdp", [False, True])
+    def test_wrapper_bitwise(self, tmp_path, fsdp):
+        wrap = lambda n: ParallelWrapper(n, mesh=build_mesh(), fsdp=fsdp)
+        base, resumed = _run_preempt_resume(
+            _ff_net, lambda: ListDataSetIterator(_ff_data(), 16),
+            tmp_path, epochs=4, wrap=wrap)
+        _assert_trees(base.params, resumed.params)
+
+    def test_elastic_resume_onto_different_device_count(self, tmp_path):
+        """Preempt at dp=8, resume at dp=4 (and FSDP): the restored key
+        stream is identical, only the all-reduce summation order
+        changes — <=1e-6, never a restart-from-scratch."""
+        mesh8 = build_mesh()
+        mesh4 = build_mesh(devices=jax.devices()[:4])
+        base, resumed = _run_preempt_resume(
+            _ff_net, lambda: ListDataSetIterator(_ff_data(), 16),
+            tmp_path, epochs=4,
+            wrap=lambda n: ParallelWrapper(n, mesh=mesh8),
+            resume_wrap=lambda n: ParallelWrapper(n, mesh=mesh4,
+                                                  fsdp=True))
+        _assert_trees(base.params, resumed.params, bitwise=False)
+
+    def test_elastic_indivisible_width_replicates_and_streams(
+            self, tmp_path):
+        """Resume onto a width the batch axis does not divide: the
+        rebuilt cache replicates on-mesh (n_shard=1) and training still
+        completes to <=1e-6 of the uninterrupted run."""
+        mesh5 = build_mesh(devices=jax.devices()[:5])
+        base, resumed = _run_preempt_resume(
+            _ff_net, lambda: ListDataSetIterator(_ff_data(), 16),
+            tmp_path, epochs=4,
+            wrap=lambda n: ParallelWrapper(n, mesh=build_mesh()),
+            resume_wrap=lambda n: ParallelWrapper(n, mesh=mesh5))
+        cache = ParallelWrapper(_ff_net(), mesh=mesh5).build_epoch_cache(
+            ListDataSetIterator(_ff_data(), 16))
+        assert cache is not None and cache.n_shard == 1
+        _assert_trees(base.params, resumed.params, bitwise=False)
+
+    def test_resume_with_nothing_left_is_a_noop(self, tmp_path):
+        net = _ff_net()
+        t = FaultTolerantTrainer(net, str(tmp_path))
+        t.fit_epochs(ListDataSetIterator(_ff_data(), 16), 2)
+        n2 = _ff_net()
+        t2 = FaultTolerantTrainer(n2, str(tmp_path))
+        assert t2.resume()
+        before = jax.tree_util.tree_map(np.asarray, n2.params)
+        assert t2.fit_epochs(ListDataSetIterator(_ff_data(), 16),
+                             2) is None
+        _assert_trees(before, n2.params)
+
+
+class TestSaveAsync:
+    def test_async_save_is_verified_and_restorable(self, tmp_path):
+        net = _ff_net()
+        net.fit_epochs(ListDataSetIterator(_ff_data(), 16), 1)
+        t = FaultTolerantTrainer(net, str(tmp_path))
+        fut = t.save_async()
+        # the next dispatch does not wait for the writer
+        net.fit_epochs(ListDataSetIterator(_ff_data(), 16), 1)
+        path = fut.result(timeout=30)
+        assert t.verify_checkpoint(path) == "ok"
+        n2 = _ff_net()
+        t2 = FaultTolerantTrainer(n2, str(tmp_path))
+        assert t2.resume()
+        assert n2.iteration_count == 4  # the snapshot, not the later run
+
+    def test_training_state_round_trips(self, tmp_path):
+        net = _ff_net()
+        net.fit_epochs(ListDataSetIterator(_ff_data(), 16), 2)
+        net._epoch_cursor = 2
+        net._lr_scale_host = 0.25
+        t = FaultTolerantTrainer(net, str(tmp_path))
+        t.save()
+        n2 = _ff_net()
+        t2 = FaultTolerantTrainer(n2, str(tmp_path))
+        assert t2.resume()
+        assert n2._epoch_cursor == 2
+        assert n2._lr_scale_host == pytest.approx(0.25)
+        np.testing.assert_array_equal(np.asarray(n2._rng),
+                                      np.asarray(net._rng))
+
+    def test_sync_save_waits_for_inflight_async(self, tmp_path):
+        net = _ff_net()
+        net.fit_epochs(ListDataSetIterator(_ff_data(), 16), 1)
+        t = FaultTolerantTrainer(net, str(tmp_path))
+        t.save_async()
+        p = t.save()  # must not interleave with the writer thread
+        assert t.verify_checkpoint(p) == "ok"
+
+
+@pytest.mark.chaos
+class TestPreemptionGuard:
+    def test_sigterm_latches_and_process_survives(self):
+        with PreemptionGuard() as guard:
+            assert not guard.requested()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5
+            while not guard.requested() and time.time() < deadline:
+                time.sleep(0.01)
+            assert guard.requested()
+            assert guard.check()
+
+    def test_fault_site_counts_as_preemption(self):
+        guard = PreemptionGuard(signals=())
+        with inject("preempt.chunk", fail_nth(2)):
+            assert not guard.check()
+            assert guard.check()
+        assert guard.requested()
+
+    def test_per_step_fit_resumes_mid_epoch(self, tmp_path):
+        """The per-step path checkpoints a STEP cursor: resume skips
+        exactly the consumed batches instead of restarting the epoch."""
+        data = lambda: ListDataSetIterator(_ff_data(), 16)
+        base = _sgd_net()
+        FaultTolerantTrainer(base, str(tmp_path / "base")).fit(data())
+
+        n2 = _sgd_net()
+        t2 = FaultTolerantTrainer(n2, str(tmp_path / "pre"),
+                                  checkpoint_every=1)
+        guard = PreemptionGuard(signals=())
+        with inject("preempt.chunk", fail_nth(2)):
+            t2.fit(data(), preemption=guard)
+        assert t2.preempted
+        assert n2._step_cursor == 2  # two of four batches consumed
+
+        n3 = _sgd_net()
+        t3 = FaultTolerantTrainer(n3, str(tmp_path / "pre"))
+        assert t3.resume()
+        assert n3._step_cursor == 2
+        t3.fit(data())
+        assert base.iteration_count == n3.iteration_count
+        _assert_trees(base.params, n3.params)
+
+
+@pytest.mark.chaos
+class TestChunkWatchdogAndFaultSites:
+    def test_epoch_chunk_fault_site_fires(self):
+        net = _ff_net()
+        with inject("epoch.chunk", fail_nth(2)):
+            with pytest.raises(Exception, match="injected fault"):
+                net.fit_epochs(ListDataSetIterator(_ff_data(), 16), 3,
+                               chunk_epochs=1)
+
+    def test_hung_chunk_logged_as_stall(self, monkeypatch, caplog):
+        """A wedged dispatch surfaces as a watchdog stall log, not a
+        silent hang: per-step budget shrunk via DL4J_STEP_DEADLINE_S,
+        host stalled between chunks via a delay at epoch.chunk."""
+        from deeplearning4j_tpu.resilience import delay
+
+        monkeypatch.setenv("DL4J_STEP_DEADLINE_S", "0.005")
+        net = _ff_net()
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.resilience."
+                                    "watchdog"):
+            with inject("epoch.chunk", delay(300)):
+                net.fit_epochs(ListDataSetIterator(_ff_data(), 16), 2,
+                               chunk_epochs=1)
+        assert net._chunk_watchdog.stalls >= 1
+        assert any("hung" in r.message for r in caplog.records)
+
+    def test_deadline_scales_with_chunk_size(self, monkeypatch):
+        from deeplearning4j_tpu.perf.epoch_cache import chunk_deadline_s
+
+        assert chunk_deadline_s(1) == 120.0
+        assert chunk_deadline_s(100) == 3000.0
+        monkeypatch.setenv("DL4J_STEP_DEADLINE_S", "2")
+        assert chunk_deadline_s(10) == 20.0
+
+
+# ---------------------------------------------------------------------------
+# satellites: minimize() guard routing, async producer batch index
+# ---------------------------------------------------------------------------
+
+
+class TestMinimizeNanGuard:
+    @staticmethod
+    def _value_and_grad_with_nan_at(bad_iteration):
+        calls = {"n": -1}
+
+        def vg(p):
+            calls["n"] += 1
+            if calls["n"] == bad_iteration:
+                return float("nan"), np.full_like(p, np.nan)
+            return float(p @ p), 2 * p
+
+        return vg
+
+    def test_raise_policy(self):
+        from deeplearning4j_tpu.optimize.function import minimize
+        from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+        with pytest.raises(TrainingDivergedError) as ei:
+            minimize(self._value_and_grad_with_nan_at(2),
+                     np.ones(3),
+                     algo=OptimizationAlgorithm
+                     .STOCHASTIC_GRADIENT_DESCENT,
+                     iterations=5, learning_rate=0.1, nan_guard="raise")
+        assert ei.value.step == 2
+
+    def test_skip_policy_skips_the_update(self):
+        from deeplearning4j_tpu.optimize.function import minimize
+        from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+        params, score, history = minimize(
+            self._value_and_grad_with_nan_at(1), np.ones(3),
+            algo=OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT,
+            iterations=4, learning_rate=0.1, nan_guard="skip",
+            rescore_final=False)
+        assert np.isfinite(params).all() and np.isfinite(score)
+        assert np.isnan(history[1])  # the bad evaluation is on record
+
+    def test_halve_lr_policy_shrinks_steps(self):
+        from deeplearning4j_tpu.optimize.function import minimize
+        from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+        # identical trajectories before the trip; after it the halved
+        # branch must take a smaller step than an untripped run would
+        p_halved, _, _ = minimize(
+            self._value_and_grad_with_nan_at(1), np.ones(3),
+            algo=OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT,
+            iterations=3, learning_rate=0.1, nan_guard="halve_lr",
+            rescore_final=False)
+        p_skip, _, _ = minimize(
+            self._value_and_grad_with_nan_at(1), np.ones(3),
+            algo=OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT,
+            iterations=3, learning_rate=0.1, nan_guard="skip",
+            rescore_final=False)
+        # halved LR moves less from the shared post-trip iterate
+        assert np.linalg.norm(p_halved) > np.linalg.norm(p_skip)
+
+
+class TestAsyncProducerBatchIndex:
+    class _Boom(ListDataSetIterator):
+        def __init__(self, ds, batch_size, bad_index):
+            super().__init__(ds, batch_size)
+            self.bad_index = bad_index
+
+        def next(self, num=None):
+            if self._pos == self.bad_index:
+                raise ValueError("corrupt shard")
+            return super().next(num)
+
+    def test_consumer_sees_originating_batch_index(self):
+        it = AsyncDataSetIterator(
+            self._Boom(_ff_data(), 16, bad_index=2), queue_size=2)
+        with pytest.raises(ValueError, match="corrupt shard.*batch #2"):
+            while it.has_next():
+                it.next()
+
+    def test_epoch_cache_drain_propagates_index(self):
+        from deeplearning4j_tpu.perf.epoch_cache import DeviceDataSetCache
+
+        it = AsyncDataSetIterator(
+            self._Boom(_ff_data(), 16, bad_index=1), queue_size=2)
+        with pytest.raises(ValueError) as ei:
+            DeviceDataSetCache.build(it)
+        assert ei.value.batch_index == 1

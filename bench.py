@@ -32,6 +32,12 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
     (ParallelWrapper.fit_epochs) — weak-scaling samples/sec/chip +
     dispatches-per-epoch (must stay 1 at any device count); skipped
     when only one device is visible
+  - guard: numeric-sentinel overhead (on vs off, <3% target) + async
+    checkpoint blocking time
+  - telemetry: in-program metrics-pack overhead (on vs off, <3%
+    target) + exporter round-trip; every artifact this bench writes —
+    including partials and error lines — embeds a metrics+span summary
+    block ("telemetry" key) with the grant-acquisition timeline
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs are analytic (formula noted per entry in "flops_source").
@@ -47,6 +53,16 @@ import sys
 import time
 
 import numpy as np
+
+# stdlib-only telemetry layer (monitor/ imports no jax): safe to import
+# before the backend probe — the spans it records around grant
+# acquisition are exactly the wedge-timeline evidence BENCH_r04/r05
+# lacked
+from deeplearning4j_tpu.monitor import (
+    record_counter as _record_counter,
+    telemetry_summary as _telemetry_summary,
+    tracer as _tracer,
+)
 
 PEAK_TFLOPS_BF16 = 197.0  # TPU v5e per-chip peak, bf16 MXU
 
@@ -535,6 +551,87 @@ def bench_guard():
             "batch": batch, "n_batches": n_batches, "epochs": epochs}
 
 
+def bench_telemetry():
+    """Telemetry overhead: fused-epoch throughput with the in-program
+    metrics pack compiled in (grad/update/param global-norms + lr scale
+    per step, DL4J_TELEMETRY=on stride 1) vs compiled out — the pack's
+    budget is <3% like the NaN sentinel's. The run keeps the default
+    guard (skip) on BOTH sides so the delta isolates the pack. Also
+    reports the exporter round-trip (JSONL metrics record + Prometheus
+    textfile per snapshot) and the host cost of draining one chunk's
+    [E, N, 4] history."""
+    import os
+    import tempfile
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models import mnist_mlp
+    from deeplearning4j_tpu.monitor import metrics
+    from deeplearning4j_tpu.monitor.exporters import (
+        JsonlExporter, write_prometheus_textfile)
+    from deeplearning4j_tpu.perf.epoch_cache import DeviceDataSetCache
+
+    rng = np.random.default_rng(0)
+    batch, n_batches, epochs = 2048, 16, 5
+    ds = DataSet(rng.random((batch * n_batches, 784), np.float32),
+                 np.eye(10, dtype=np.float32)[
+                     rng.integers(0, 10, batch * n_batches)])
+    total = batch * n_batches
+
+    def prep(telemetry):
+        net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+        cache = DeviceDataSetCache.build(ListDataSetIterator(ds, batch))
+        assert cache is not None, "bench dataset exceeded DL4J_DEVICE_CACHE_MB"
+        net.fit_epochs(cache, epochs, chunk_epochs=1, telemetry=telemetry)
+        _sync(net.params)  # warm: compile outside the timing
+        return net, cache
+
+    def timed(net, cache, telemetry):
+        t0 = time.perf_counter()
+        net.fit_epochs(cache, epochs, chunk_epochs=1, telemetry=telemetry)
+        _sync(net.params)
+        return total * epochs / (time.perf_counter() - t0)
+
+    off_net, off_cache = prep(False)
+    on_net, on_cache = prep(1)
+    # best-of-3, interleaved: host timing jitter dwarfs a few-% delta
+    off_sps = max(timed(off_net, off_cache, False) for _ in range(3))
+    on_sps = max(timed(on_net, on_cache, 1) for _ in range(3))
+    overhead_pct = (off_sps / on_sps - 1.0) * 100.0
+
+    # the [E, N, 4] history drain: the one host readback a per-chunk
+    # metrics consumer pays
+    t0 = time.perf_counter()
+    hist = np.asarray(on_net._last_metrics)
+    drain_ms = (time.perf_counter() - t0) * 1e3
+    finite_frac = float(np.isfinite(hist).mean())
+
+    # exporter round-trip on the live registry
+    with tempfile.TemporaryDirectory() as d:
+        t0 = time.perf_counter()
+        JsonlExporter(os.path.join(d, "telemetry.jsonl")).write(
+            {"kind": "metrics", "metrics": metrics().snapshot()})
+        prom = write_prometheus_textfile(
+            metrics(), os.path.join(d, "metrics.prom"))
+        export_ms = (time.perf_counter() - t0) * 1e3
+        prom_bytes = os.path.getsize(prom) if prom else 0
+
+    _log(f"telemetry: {on_sps:,.0f} samples/sec with metrics pack vs "
+         f"{off_sps:,.0f} without ({overhead_pct:+.2f}% overhead, target "
+         f"<3%); history drain {drain_ms:.1f} ms, exporters "
+         f"{export_ms:.1f} ms ({prom_bytes} B prom)")
+    return {"pack_samples_per_sec": round(on_sps, 1),
+            "no_pack_samples_per_sec": round(off_sps, 1),
+            "pack_overhead_pct": round(overhead_pct, 2),
+            "overhead_within_target": bool(overhead_pct < 3.0),
+            "metrics_history_shape": list(hist.shape),
+            "metrics_finite_fraction": round(finite_frac, 4),
+            "history_drain_ms": round(drain_ms, 2),
+            "exporter_roundtrip_ms": round(export_ms, 2),
+            "prometheus_bytes": prom_bytes,
+            "batch": batch, "n_batches": n_batches, "epochs": epochs}
+
+
 def bench_eval():
     """Inference/eval path: device-resident confusion accumulation vs the
     host path (per-batch logit readback) on a stream of ragged batches.
@@ -821,8 +918,17 @@ def _await_backend(timeout_s: float = None):
                                        str(min(timeout_s, 90.0))))
     except ValueError:
         probe_s = min(timeout_s, 90.0)
-    ok, detail = _probe_backend_subprocess(probe_s)
+    # grant-acquisition spans: the BENCH_r04/r05 wedge class is a grant
+    # that blocks for hours — these spans (and the watchdog events on
+    # timeout) make the wedge diagnosable from the JSON artifact alone
+    with _tracer().span("grant.probe", timeout_s=probe_s) as sp:
+        ok, detail = _probe_backend_subprocess(probe_s)
+        sp.attrs["ok"] = ok
+        sp.attrs["detail"] = str(detail)[:200]
     if not ok:
+        _tracer().event("grant.watchdog", phase="probe",
+                        timeout_s=probe_s, detail=str(detail)[:200])
+        _record_counter("grant_wedges_total", phase="probe")
         _log(f"BACKEND UNAVAILABLE (child probe): {detail}")
         err = {"error": f"backend unavailable: {detail}"}
         # the sidecar is the durable record: without this flush a wedged
@@ -846,17 +952,35 @@ def _await_backend(timeout_s: float = None):
             result["error"] = str(e)[:300]
         ready.set()
 
-    threading.Thread(target=probe, daemon=True).start()
-    if not ready.wait(timeout_s) or "error" in result:
+    with _tracer().span("grant.acquire", timeout_s=timeout_s) as sp:
+        threading.Thread(target=probe, daemon=True).start()
+        acquired = ready.wait(timeout_s) and "error" not in result
+        sp.attrs["ok"] = acquired
+    if not acquired:
         err = result.get(
             "error", f"backend init did not complete in {timeout_s:.0f}s "
                      "after a successful child probe (grant re-wedged?)")
+        _tracer().event("grant.watchdog", phase="acquire",
+                        timeout_s=timeout_s, detail=str(err)[:200])
+        _record_counter("grant_wedges_total", phase="acquire")
         _log(f"BACKEND UNAVAILABLE: {err}")
         err_extras = {"error": f"backend unavailable: {err}"}
         _flush_partial(err_extras, complete=True)
         print(_result_line(err_extras, None, float("nan")), flush=True)
         os._exit(0)
     _log(f"backend up: {result['devices']}")
+
+
+def _refresh_telemetry(extras):
+    """(Re)attach the metrics+span summary block. Called at every flush
+    and on the final result line, so EVERY artifact — complete, partial,
+    or error — carries the current timeline (a wedged grant produces a
+    diagnosable record instead of a bare error line)."""
+    try:
+        extras["telemetry"] = _telemetry_summary()
+    except Exception as e:  # telemetry must never break the bench
+        _log(f"telemetry summary failed: {e}")
+    return extras
 
 
 def _result_line(extras, headline_value, vs_baseline):
@@ -866,7 +990,7 @@ def _result_line(extras, headline_value, vs_baseline):
         "unit": "tokens/sec",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline == vs_baseline
         else None,
-        "extras": extras,
+        "extras": _refresh_telemetry(extras),
     })
 
 
@@ -881,7 +1005,8 @@ def _flush_partial(extras, complete=False):
     handler covers the kill-between-configs case on stdout."""
     try:
         with open(PARTIAL_PATH, "w") as f:
-            json.dump({"complete": complete, "extras": extras}, f)
+            json.dump({"complete": complete,
+                       "extras": _refresh_telemetry(extras)}, f)
     except OSError as e:
         _log(f"partial flush failed: {e}")
 
@@ -948,7 +1073,8 @@ def main() -> None:
                 ("eval", bench_eval),
                 ("epoch", bench_epoch),
                 ("dp_epoch", bench_dp_epoch),
-                ("guard", bench_guard)]
+                ("guard", bench_guard),
+                ("telemetry", bench_telemetry)]
     if only:
         known = {n for n, _ in sections} | {"transformer"}
         unknown = sorted(only - known)
@@ -960,27 +1086,52 @@ def main() -> None:
         extras["bench_only"] = sorted(only)
         if skipped:
             _log(f"BENCH_ONLY={sorted(only)}: skipping {skipped}")
-    for name, fn in sections:
-        try:
-            extras[name] = fn()
-        except Exception as e:  # keep the bench robust to one bad config
-            extras[name] = {"error": str(e)[:200]}
-            _log(f"{name} FAILED: {e}")
-        _flush_partial(extras)
-
     try:
-        def tf_progress(partial):
-            extras["transformer_lm"] = partial
+        for name, fn in sections:
+            sp = None
+            try:
+                # the span stamps the section with tracer start/end
+                # timestamps; an exception mid-section is recorded on it
+                with _tracer().span(f"bench.{name}") as sp:
+                    extras[name] = fn()
+            except Exception as e:  # keep the bench robust to one bad config
+                extras[name] = {"error": str(e)[:200]}
+                _log(f"{name} FAILED: {e}")
+            if sp is not None and isinstance(extras.get(name), dict):
+                extras[name]["section_span"] = {
+                    "start_s": round(sp.start_s, 3),
+                    "end_s": round(sp.end_s, 3),
+                    "wall_s": round(sp.duration_s, 3)}
+            # flush on EVERY section outcome — success or exception —
+            # so the sidecar is never more than one section stale
             _flush_partial(extras)
 
-        tf, vs_baseline = bench_transformer(on_progress=tf_progress)
-        extras["transformer_lm"] = tf
-        headline_value = tf.get("tokens_per_sec")
-    except Exception as e:
-        extras["transformer_lm"] = {"error": str(e)[:200]}
-        _log(f"transformer FAILED: {e}")
-        headline_value = None
-        vs_baseline = float("nan")
+        try:
+            def tf_progress(partial):
+                extras["transformer_lm"] = partial
+                _flush_partial(extras)
+
+            with _tracer().span("bench.transformer") as tf_span:
+                tf, vs_baseline = bench_transformer(on_progress=tf_progress)
+            tf["section_span"] = {
+                "start_s": round(tf_span.start_s, 3),
+                "end_s": round(tf_span.end_s, 3),
+                "wall_s": round(tf_span.duration_s, 3)}
+            extras["transformer_lm"] = tf
+            headline_value = tf.get("tokens_per_sec")
+        except Exception as e:
+            extras["transformer_lm"] = {"error": str(e)[:200]}
+            _log(f"transformer FAILED: {e}")
+            headline_value = None
+            vs_baseline = float("nan")
+    except BaseException as e:
+        # anything that escapes the per-section nets (SystemExit,
+        # KeyboardInterrupt, MemoryError) still leaves a durable record
+        # with the timeline of what ran
+        extras.setdefault("error",
+                          f"bench aborted: {type(e).__name__}: {e}"[:300])
+        _flush_partial(extras)
+        raise
 
     _uninstall_partial_emitter()
     _flush_partial(extras, complete=True)

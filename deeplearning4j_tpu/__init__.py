@@ -15,10 +15,34 @@ Python/C++.
 
 __version__ = "0.1.0"
 
-from deeplearning4j_tpu.nn.conf import (  # noqa: F401
-    NeuralNetConfiguration,
-    MultiLayerConfiguration,
-    ComputationGraphConfiguration,
-)
-from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
-from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401
+# The top-level conveniences resolve lazily (PEP 562): the network classes
+# pull in jax, and control-plane consumers — bench.py's pre-probe telemetry
+# import, __graft_entry__'s dryrun parent — must be able to import
+# ``deeplearning4j_tpu.monitor`` (stdlib-only) BEFORE any jax/backend
+# initialization. ``from deeplearning4j_tpu import MultiLayerNetwork`` is
+# unchanged for users.
+_LAZY_ATTRS = {
+    "NeuralNetConfiguration": "deeplearning4j_tpu.nn.conf",
+    "MultiLayerConfiguration": "deeplearning4j_tpu.nn.conf",
+    "ComputationGraphConfiguration": "deeplearning4j_tpu.nn.conf",
+    "MultiLayerNetwork": "deeplearning4j_tpu.nn.multilayer",
+    "ComputationGraph": "deeplearning4j_tpu.nn.graph",
+}
+
+__all__ = ["__version__", *_LAZY_ATTRS]
+
+
+def __getattr__(name):
+    target = _LAZY_ATTRS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # cache: subsequent lookups skip __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))

@@ -1,0 +1,119 @@
+"""Telemetry subsystem: metrics registry, span tracer, exporters, and the
+in-program metrics pack.
+
+The reference dl4j treats listeners + the web UI as a first-class
+observability bus; our fused pipeline collapsed E x N optimizer steps into
+one opaque XLA dispatch and left only ad-hoc per-class counters behind.
+This package is the cross-cutting layer that fixes it (see
+``docs/observability.md`` for the metric catalog, span taxonomy, and
+exporter formats):
+
+- :mod:`~deeplearning4j_tpu.monitor.registry` — ``MetricsRegistry``
+  (counters / gauges / histograms with labels); ``metrics()`` is the
+  process-global instance the scattered counters land behind.
+- :mod:`~deeplearning4j_tpu.monitor.trace` — ``SpanTracer``
+  (context-manager spans, monotonic timestamps, parent ids, injectable
+  clock); ``tracer()`` is the process-global instance instrumenting
+  chunk dispatch, readbacks, cache builds, checkpoints, grant
+  acquisition, and retry sleeps.
+- :mod:`~deeplearning4j_tpu.monitor.exporters` — JSONL event log +
+  Prometheus textfile (``DL4J_TELEMETRY_DIR``) and the
+  ``telemetry_summary()`` block bench artifacts embed.
+- :mod:`~deeplearning4j_tpu.monitor.pack` — the DEVICE-side per-step
+  metrics pack the fused epoch program optionally carries (grad/update/
+  param global-norms + lr scale as an ``[E, N, 4]`` history). Imported
+  separately by the network classes; this ``__init__`` stays
+  stdlib-only so control-plane modules can import it before (or
+  without) jax.
+
+Env surface: ``DL4J_TELEMETRY`` (``on`` compiles the metrics pack into
+the fused step; default off = bitwise PR-5 program),
+``DL4J_TELEMETRY_STRIDE`` (compute the pack every N-th iteration), and
+``DL4J_TELEMETRY_DIR`` (enable file exporters). Registry + tracer are
+always live — they are host-side and effectively free.
+"""
+
+from __future__ import annotations
+
+import os
+
+from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics,
+)
+from deeplearning4j_tpu.monitor.trace import (  # noqa: F401
+    Span,
+    SpanTracer,
+    set_tracer,
+    tracer,
+)
+from deeplearning4j_tpu.monitor.exporters import (  # noqa: F401
+    JsonlExporter,
+    export_metrics_jsonl,
+    telemetry_dir,
+    telemetry_summary,
+    write_prometheus_textfile,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
+    "Span", "SpanTracer", "set_tracer", "tracer",
+    "JsonlExporter", "export_metrics_jsonl", "telemetry_dir",
+    "telemetry_summary", "write_prometheus_textfile",
+    "telemetry_enabled", "metrics_stride", "fused_metrics_stride",
+    "record_counter",
+]
+
+_ON = ("1", "on", "true", "yes")
+_OFF = ("", "0", "off", "false", "no")
+
+
+def telemetry_enabled() -> bool:
+    """``DL4J_TELEMETRY``: ``on`` compiles the in-program metrics pack
+    into the fused epoch step. Default OFF — the fused program stays
+    bitwise-identical to the pre-telemetry build."""
+    raw = os.environ.get("DL4J_TELEMETRY", "").strip().lower()
+    if raw in _ON:
+        return True
+    if raw not in _OFF:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "DL4J_TELEMETRY=%r is not on/off; treating as off", raw)
+    return False
+
+
+def metrics_stride() -> int:
+    """``DL4J_TELEMETRY_STRIDE`` (default 1): compute the metrics pack on
+    every stride-th iteration of the fused program; off-stride history
+    rows are NaN. Only meaningful with ``DL4J_TELEMETRY=on``."""
+    raw = os.environ.get("DL4J_TELEMETRY_STRIDE", "")
+    try:
+        return max(1, int(raw)) if raw else 1
+    except ValueError:
+        return 1
+
+
+def fused_metrics_stride(override=None) -> int:
+    """Resolve a ``fit_epochs(telemetry=...)`` override to the static
+    stride baked into the fused program: 0 = pack compiled out.
+    ``None`` -> the env (``DL4J_TELEMETRY`` / ``DL4J_TELEMETRY_STRIDE``),
+    ``False`` -> 0, ``True`` -> the env stride, an int -> that stride
+    (0 disables)."""
+    if override is None:
+        return metrics_stride() if telemetry_enabled() else 0
+    if override is False:
+        return 0
+    if override is True:
+        return metrics_stride()
+    return max(0, int(override))
+
+
+def record_counter(name: str, amount: float = 1.0, **labels) -> None:
+    """One-line counter bump against the global registry — the idiom the
+    control plane uses instead of growing new bare ``_*_counter``
+    attributes (``scripts/lint_telemetry.py`` enforces it)."""
+    metrics().counter(name).inc(amount, **labels)

@@ -1,0 +1,158 @@
+"""Telemetry exporters: JSONL event log, Prometheus textfile, summary block.
+
+Three sinks, one source of truth (the :mod:`registry` snapshot and the
+:mod:`trace` ring):
+
+- **JSONL** (``DL4J_TELEMETRY_DIR/telemetry.jsonl``) — append-only event
+  log; each line is ``{"kind": "span"|"metrics", "t_wall": <unix>, ...}``.
+  The span sink streams every finished span; ``export_metrics_jsonl``
+  appends a registry snapshot on demand (drive/bench call it per run).
+- **Prometheus textfile** (``DL4J_TELEMETRY_DIR/metrics.prom``) — the
+  node-exporter textfile-collector dialect, one snapshot per write; a
+  scraper (or a human) reads counters/gauges/histograms with labels.
+- **Summary block** (``telemetry_summary()``) — the dict embedded in
+  every ``BENCH_*.json`` / ``bench_partial.json``: metrics snapshot +
+  per-span-name aggregates + the recent-span timeline, so a wedged grant
+  leaves a diagnosable artifact instead of a bare error line.
+
+All exporters degrade silently on I/O errors — telemetry must never be
+the thing that kills a training run.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "JsonlExporter",
+    "export_metrics_jsonl",
+    "span_sink_from_env",
+    "telemetry_dir",
+    "telemetry_summary",
+    "write_prometheus_textfile",
+]
+
+JSONL_NAME = "telemetry.jsonl"
+PROM_NAME = "metrics.prom"
+
+
+def telemetry_dir() -> Optional[str]:
+    """``DL4J_TELEMETRY_DIR`` — directory for the JSONL event log and the
+    Prometheus textfile; unset disables file export entirely."""
+    d = os.environ.get("DL4J_TELEMETRY_DIR", "").strip()
+    return d or None
+
+
+class JsonlExporter:
+    """Append-only JSON-lines writer (thread-safe, best-effort I/O)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._warned = False
+
+    def write(self, record: dict) -> None:
+        line = json.dumps(record, default=_json_default)
+        try:
+            with self._lock:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+        except OSError as e:
+            if not self._warned:  # complain once, not per event
+                self._warned = True
+                logger.warning("telemetry JSONL write to %s failed: %s "
+                               "(further failures silent)", self.path, e)
+
+
+def _json_default(o):
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+def span_sink_from_env() -> Optional[Callable[[dict], None]]:
+    """A span sink streaming to ``DL4J_TELEMETRY_DIR/telemetry.jsonl``,
+    or None when the env var is unset (tracing stays in-memory only)."""
+    d = telemetry_dir()
+    if d is None:
+        return None
+    exporter = JsonlExporter(os.path.join(d, JSONL_NAME))
+
+    def sink(span_dict: dict) -> None:
+        exporter.write({"kind": "span", "t_wall": time.time(),
+                        **span_dict})
+
+    return sink
+
+
+def export_metrics_jsonl(registry=None, path: Optional[str] = None
+                         ) -> Optional[str]:
+    """Append one registry snapshot to the JSONL log; returns the path
+    written (None when no directory is configured and no path given)."""
+    if registry is None:
+        from deeplearning4j_tpu.monitor.registry import metrics
+
+        registry = metrics()
+    if path is None:
+        d = telemetry_dir()
+        if d is None:
+            return None
+        path = os.path.join(d, JSONL_NAME)
+    JsonlExporter(path).write({"kind": "metrics", "t_wall": time.time(),
+                               "metrics": registry.snapshot()})
+    return path
+
+
+def write_prometheus_textfile(registry=None, path: Optional[str] = None
+                              ) -> Optional[str]:
+    """Write the registry as a Prometheus textfile snapshot (atomic
+    tmp+rename, so a scraper never reads a torn file). Returns the path,
+    or None when no directory is configured and no path given."""
+    if registry is None:
+        from deeplearning4j_tpu.monitor.registry import metrics
+
+        registry = metrics()
+    if path is None:
+        d = telemetry_dir()
+        if d is None:
+            return None
+        path = os.path.join(d, PROM_NAME)
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(registry.to_prometheus())
+        os.replace(tmp, path)
+    except OSError as e:
+        logger.warning("prometheus textfile write to %s failed: %s",
+                       path, e)
+        return None
+    return path
+
+
+def telemetry_summary(registry=None, span_tracer=None,
+                      recent_spans: int = 40) -> dict:
+    """The metrics+span summary block bench artifacts embed: registry
+    snapshot, per-span-name aggregates, and the recent-span timeline."""
+    if registry is None:
+        from deeplearning4j_tpu.monitor.registry import metrics
+
+        registry = metrics()
+    if span_tracer is None:
+        from deeplearning4j_tpu.monitor.trace import tracer
+
+        span_tracer = tracer()
+    return {
+        "metrics": registry.snapshot(),
+        "spans": span_tracer.summary(recent=recent_spans),
+    }

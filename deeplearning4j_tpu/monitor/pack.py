@@ -1,0 +1,81 @@
+"""The in-program metrics pack: device-side per-step training diagnostics.
+
+The fused epoch pipeline collapses E x N optimizer steps into one XLA
+dispatch — per-step host listeners cannot observe gradient health without
+breaking the fusion with E*N device syncs. The metrics pack moves the
+observation INTO the program, exactly like the NaN sentinel: each fused
+step optionally emits a ``[4]`` f32 vector
+
+    [grad global-norm, update global-norm, param global-norm, lr scale]
+
+which the epoch scan stacks into an ``[E, N, 4]`` history returned beside
+the loss (and sentinel) histories — one readback per chunk, zero extra
+syncs. ``DL4J_TELEMETRY=off`` (the default) compiles the pack out
+entirely: the program is the PR-5 program, bitwise
+(``tests/test_telemetry.py`` asserts it). A stride > 1
+(``DL4J_TELEMETRY_STRIDE``) computes the norms only on every stride-th
+iteration via ``lax.cond`` (off-stride rows are NaN — unmistakably "not
+measured", never confusable with a zero norm), bounding the overhead on
+models where three global norms per step are not already noise.
+
+Semantics under the sentinel: a tripped (skipped) step carries params
+unchanged, so its update norm is exactly 0 and its param norm equals the
+pre-step norm; the grad norm is whatever non-finite value tripped it —
+the diagnostic signal the skip policy's end-of-run warning points at.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["METRIC_NAMES", "N_METRICS", "step_metrics",
+           "tree_global_norm"]
+
+# column order of the [E, N, 4] metrics history
+METRIC_NAMES = ("grad_norm", "update_norm", "param_norm", "lr_scale")
+N_METRICS = len(METRIC_NAMES)
+
+
+def tree_global_norm(tree):
+    """Traced f32 global L2 norm over every floating leaf of ``tree``
+    (integer leaves — updater step counters — are skipped). Accumulates
+    in f32 regardless of leaf dtype so bf16 params do not overflow the
+    sum of squares."""
+    sq = [jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+          for leaf in jax.tree_util.tree_leaves(tree)
+          if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)]
+    if not sq:
+        return jnp.float32(0.0)
+    return jnp.sqrt(functools.reduce(jnp.add, sq))
+
+
+def step_metrics(params, new_params, grads, lr_scale, iteration,
+                 stride: int):
+    """The ``[4]`` f32 metrics vector for one fused optimizer step.
+
+    ``params``/``new_params`` are the pre-/post-step trees (their
+    difference is the applied update — the optimizer-adapted direction
+    actually taken, not the raw gradient), ``lr_scale`` the traced
+    effective LR multiplier. ``stride > 1`` gates the norm computation
+    behind ``lax.cond`` on the traced iteration counter; skipped rows
+    are NaN."""
+
+    def compute(_):
+        upd = jax.tree_util.tree_map(
+            lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+            new_params, params)
+        return jnp.stack([
+            tree_global_norm(grads),
+            tree_global_norm(upd),
+            tree_global_norm(new_params),
+            jnp.asarray(lr_scale, jnp.float32),
+        ])
+
+    if stride <= 1:
+        return compute(None)
+    return jax.lax.cond(
+        iteration % stride == 0, compute,
+        lambda _: jnp.full((N_METRICS,), jnp.nan, jnp.float32), None)

@@ -54,6 +54,7 @@ from deeplearning4j_tpu.perf.bucketing import (
     pad_axis0,
     padded_label_mask,
 )
+from deeplearning4j_tpu.monitor import fused_metrics_stride, record_counter
 from deeplearning4j_tpu.perf.device_eval import confusion_update
 from deeplearning4j_tpu.perf.epoch_cache import (
     DeviceMultiDataSetCache,
@@ -105,11 +106,12 @@ class ComputationGraph:
         self._eval_readbacks = 0  # host transfers made by evaluate() calls
         self._eval_steps: Dict[int, Any] = {}  # jitted eval per output head
         self._train_dispatches = 0  # train-program launches (bench evidence)
-        self._epoch_steps: Dict[Any, Any] = {}  # fused program per (shuffle, K, guard)
+        self._epoch_steps: Dict[Any, Any] = {}  # fused program per (shuffle, K, guard, stride)
         # host LR multiplier — the halve_lr divergence policy's knob (the
         # graph has no SCORE-reactive policy, so this stays 1.0 otherwise)
         self._lr_scale_host = 1.0
         self._last_sentinel = None  # [E, N] trip history of the last fit_epochs
+        self._last_metrics = None  # [E, N, 4] metrics-pack history (monitor.pack)
         self._epoch_cursor = 0  # epochs completed (checkpoint/resume cursor)
         self._step_cursor = 0  # batches into the in-progress epoch (per-step path)
 
@@ -288,12 +290,10 @@ class ComputationGraph:
         return total, (new_state, new_rnn)
 
     # ------------------------------------------------------------------
-    def _apply_updaters(self, params, updater_state, grads, iteration,
-                        lr_scale_host=None):
-        """LR schedule + per-layer updater math + parameter update — the
-        tail every optimizer-step variant (plain, accumulated, guarded)
-        shares. ``lr_scale_host`` (a traced scalar, or None = 1) is the
-        host LR multiplier the ``halve_lr`` divergence policy adjusts."""
+    def _lr_scale(self, iteration, lr_scale_host=None):
+        """Effective LR multiplier for ``iteration`` (policy scale times
+        the host ``halve_lr`` knob when given). Shared by the updater
+        apply and the telemetry pack's lr-scale column."""
         gc = self.conf.global_conf
         scale = lr_policy_scale(
             gc.lr_policy, iteration, gc.lr_policy_decay_rate,
@@ -301,6 +301,15 @@ class ComputationGraph:
             base_lr=gc.learning_rate)
         if lr_scale_host is not None:
             scale = scale * lr_scale_host
+        return scale
+
+    def _apply_updaters(self, params, updater_state, grads, iteration,
+                        lr_scale_host=None):
+        """LR schedule + per-layer updater math + parameter update — the
+        tail every optimizer-step variant (plain, accumulated, guarded)
+        shares. ``lr_scale_host`` (a traced scalar, or None = 1) is the
+        host LR multiplier the ``halve_lr`` divergence policy adjusts."""
+        scale = self._lr_scale(iteration, lr_scale_host)
         new_params, new_updater = {}, {}
         for name, spec in self.updater_specs.items():
             steps_i, upd_i = apply_updater(
@@ -448,6 +457,59 @@ class ComputationGraph:
                 ok, apply, skip, None)
         return new_params, new_updater, new_nst, loss, ~ok
 
+    def _telemetry_step_impl(self, params, updater_state, net_state,
+                             iteration, lr_scale_host, inputs, labels,
+                             feature_masks, label_masks, rng,
+                             accum_steps: int, guard: bool,
+                             metrics_stride: int):
+        """Fused-path step with the in-program metrics pack (see
+        MultiLayerNetwork._telemetry_step_impl): branch-for-branch the
+        same math as the plain/accumulated/guarded step — the unguarded
+        apply omits ``lr_scale_host`` exactly like ``_step_impl``, so
+        telemetry-on params stay bitwise-identical to telemetry-off —
+        plus the ``[4]`` f32 diagnostics vector. Returns ``(params,
+        updater, net_state, loss, tripped-or-None, metrics)``."""
+        from deeplearning4j_tpu.monitor.pack import step_metrics
+        from deeplearning4j_tpu.resilience.guard import tree_all_finite
+
+        with dtypes_mod.policy_scope(self._policy):
+            if accum_steps > 1:
+                grads, loss, nst2 = self._accum_loss_grads(
+                    params, net_state, inputs, labels, feature_masks,
+                    label_masks, rng, accum_steps)
+            else:
+                (loss, (nst2, _)), grads = self._loss_grads(
+                    params, net_state, inputs, labels, feature_masks,
+                    label_masks, rng)
+            if guard:
+                ok = jnp.isfinite(loss) & tree_all_finite(grads)
+
+                def apply(_):
+                    p2, u2 = self._apply_updaters(
+                        params, updater_state, grads, iteration,
+                        lr_scale_host)
+                    return p2, u2, nst2
+
+                def skip(_):
+                    return params, updater_state, net_state
+
+                new_params, new_updater, new_nst = jax.lax.cond(
+                    ok, apply, skip, None)
+                tripped = ~ok
+            else:
+                new_params, new_updater = self._apply_updaters(
+                    params, updater_state, grads, iteration)
+                new_nst, tripped = nst2, None
+            # report the scale actually APPLIED: the unguarded apply
+            # omits lr_scale_host (bitwise parity with _step_impl), so
+            # the lr_scale column must omit it too
+            m = step_metrics(params, new_params, grads,
+                             self._lr_scale(
+                                 iteration,
+                                 lr_scale_host if guard else None),
+                             iteration, metrics_stride)
+        return new_params, new_updater, new_nst, loss, tripped, m
+
     @functools.cached_property
     def _train_step(self):
         return jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
@@ -510,6 +572,8 @@ class ComputationGraph:
             ))
         self._score = loss
         self._train_dispatches += 1
+        record_counter("train_dispatches_total", model="ComputationGraph",
+                       path="fit_steps")
         self.iteration_count += total
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
@@ -520,7 +584,7 @@ class ComputationGraph:
     # MultiLayerNetwork.fit_epochs — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
     def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1,
-                      guard: bool = False):
+                      guard: bool = False, metrics_stride: int = 0):
         """The PURE chunk program: E epochs x N batches scanned over the
         HBM-resident ``[N, B, ...]`` stacks (tuples per input/output
         position); per-epoch device-side reshuffle via ``epoch_schedule``
@@ -529,11 +593,12 @@ class ComputationGraph:
         LR multiplier (a traced scalar — the halve_lr divergence policy
         adjusts it between chunks without recompiling); the unguarded
         step ignores it (it is 1.0 unless a guard policy changed it).
-        ``guard=True`` routes each step through the numeric sentinel and
-        returns ``(params, updater, net_state, [E, N] hist, [E, N]
-        trips)``; unguarded: ``(params, updater, net_state, hist)``.
-        Shared by the single-device jit and ``ParallelWrapper``'s SPMD
-        jit."""
+        ``guard=True`` routes each step through the numeric sentinel;
+        ``metrics_stride > 0`` compiles the in-program metrics pack in.
+        Outputs, in order: ``(params, updater, net_state, [E, N] hist[,
+        [E, N] trips][, [E, N, 4] metrics])`` — trips iff guarded,
+        metrics iff the pack is compiled in. Shared by the single-device
+        jit and ``ParallelWrapper``'s SPMD jit."""
 
         def run(params, updater_state, net_state, iteration0,
                 lr_scale_host, xs, ys, fms, lms, epoch_keys):
@@ -551,6 +616,14 @@ class ComputationGraph:
                              None if fms is None
                              else tuple(m[i] for m in fms),
                              tuple(m[i] for m in lms), rng)
+                    if metrics_stride:
+                        p2, u2, s2, loss, tripped, m = (
+                            self._telemetry_step_impl(
+                                params, upd, nst, it, lr_scale_host,
+                                *batch, accum_steps, guard,
+                                metrics_stride))
+                        out = (loss, tripped, m) if guard else (loss, m)
+                        return (p2, u2, s2, it + 1), out
                     if guard:
                         p2, u2, s2, loss, tripped = self._guarded_step_impl(
                             params, upd, nst, it, lr_scale_host, *batch,
@@ -570,22 +643,29 @@ class ComputationGraph:
 
             carry0 = (params, updater_state, net_state, iteration0)
             (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
+            if guard and metrics_stride:
+                losses, trips, mets = hist
+                return p, u, s, losses, trips, mets
             if guard:
                 losses, trips = hist
                 return p, u, s, losses, trips
+            if metrics_stride:
+                losses, mets = hist
+                return p, u, s, losses, mets
             return p, u, s, hist
 
         return run
 
     def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1,
-                          guard: bool = False):
+                          guard: bool = False, metrics_stride: int = 0):
         """Jitted fused epoch program (one entry per (shuffle, accum,
-        guard)); params/updater/net state donated, dataset stacks
-        resident."""
-        key = (shuffle, accum_steps, guard)
+        guard, metrics_stride)); params/updater/net state donated,
+        dataset stacks resident."""
+        key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard),
+            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard,
+                                            metrics_stride),
                          donate_argnums=(0, 1, 2))
             self._epoch_steps[key] = fn
         return fn
@@ -625,7 +705,8 @@ class ComputationGraph:
                    chunk_epochs: Optional[int] = None,
                    cache_mb: Optional[float] = None, mesh=None,
                    accum_steps: Optional[int] = None,
-                   guard: Optional[str] = None, on_chunk=None):
+                   guard: Optional[str] = None, telemetry=None,
+                   on_chunk=None):
         """Whole-epoch fused training over a DataSet/MultiDataSet iterator
         (or a prebuilt ``DeviceMultiDataSetCache``) — same contract as
         MultiLayerNetwork.fit_epochs: one dispatch per chunk, per-epoch
@@ -635,9 +716,12 @@ class ComputationGraph:
         sentinel under the ``guard`` (``DL4J_NAN_GUARD``) policy with the
         trip history in ``self._last_sentinel``, and
         ``on_chunk(epochs_done) -> bool`` as the chunk-boundary
-        checkpoint/preemption hook. Falls back to the per-step loop for
-        TBPTT and ``iterations > 1``; over-budget datasets stream with
-        N-deep async device prefetch."""
+        checkpoint/preemption hook, and ``telemetry=`` compiling the
+        in-program metrics pack in (``[E, N, 4]`` history in
+        ``self._last_metrics`` — see MultiLayerNetwork.fit_epochs).
+        Falls back to the per-step loop for TBPTT and ``iterations >
+        1``; over-budget datasets stream with N-deep async device
+        prefetch."""
         from deeplearning4j_tpu.resilience.guard import nan_guard_policy
 
         self._ensure_init()
@@ -666,7 +750,8 @@ class ComputationGraph:
             self._place_replicated(cache.mesh)
         guard = nan_guard_policy() if guard is None else guard
         guarded = guard != "off"
-        step = self._epoch_train_step(shuffle, accum, guarded)
+        stride = fused_metrics_stride(telemetry)
+        step = self._epoch_train_step(shuffle, accum, guarded, stride)
 
         def launch(epoch_keys):
             out = step(
@@ -675,12 +760,11 @@ class ComputationGraph:
                 jnp.asarray(self._lr_scale_host, jnp.float32),
                 cache.features, cache.labels, cache.features_masks,
                 cache.labels_masks, epoch_keys)
-            if guarded:
-                (self.params, self.updater_state, self.net_state,
-                 hist, trips) = out
-                return hist, trips
-            (self.params, self.updater_state, self.net_state, hist) = out
-            return hist, None
+            (self.params, self.updater_state, self.net_state) = out[:3]
+            hist = out[3]
+            trips = out[4] if guarded else None
+            mets = out[-1] if stride else None
+            return hist, trips, mets
 
         def replay_step(params, upd, nst, it, i, rng):
             # per-step replay for DL4J_NAN_GUARD=raise localization —
@@ -749,6 +833,8 @@ class ComputationGraph:
     def _one_iteration(self, mds: MultiDataSet, rnn_state):
         """One optimizer step; returns the new rnn carry (or None)."""
         self._train_dispatches += 1
+        record_counter("train_dispatches_total", model="ComputationGraph",
+                       path="per_step")
         self._rng, rng = jax.random.split(self._rng)
         inputs = tuple(jnp.asarray(f) for f in mds.features)
         labels = tuple(jnp.asarray(l) for l in mds.labels)
@@ -1070,6 +1156,8 @@ class ComputationGraph:
                       pad_axis0(y, b), lm)
         if cm is not None:
             self._eval_readbacks += 1
+            record_counter("eval_readbacks_total",
+                           model="ComputationGraph", kind="confusion")
             ev.eval_confusion(np.asarray(cm))  # the one host transfer
         return ev
 

@@ -59,6 +59,7 @@ from deeplearning4j_tpu.perf.device_eval import (
     init_regression_sums,
     regression_update,
 )
+from deeplearning4j_tpu.monitor import fused_metrics_stride, record_counter
 
 _RECURRENT_CONFS = (L.GravesLSTM, L.GravesBidirectionalLSTM, L.GRU, L.LSTM)
 _PRETRAIN_CONFS = (L.RBM, L.AutoEncoder, L.RecursiveAutoEncoder)
@@ -82,8 +83,9 @@ class MultiLayerNetwork:
         self._policy = dtypes_mod.policy_from_name(conf.global_conf.dtype_policy)
         self._eval_readbacks = 0  # host transfers made by evaluate() calls
         self._train_dispatches = 0  # train-program launches (bench evidence)
-        self._epoch_steps: Dict[Any, Any] = {}  # fused program per (shuffle, K, guard)
+        self._epoch_steps: Dict[Any, Any] = {}  # fused program per (shuffle, K, guard, stride)
         self._last_sentinel = None  # [E, N] trip history of the last fit_epochs
+        self._last_metrics = None  # [E, N, 4] metrics-pack history (monitor.pack)
         self._epoch_cursor = 0  # epochs completed (checkpoint/resume cursor)
         self._step_cursor = 0  # batches into the in-progress epoch (per-step path)
 
@@ -204,16 +206,22 @@ class MultiLayerNetwork:
     # the jitted train step (replaces Solver/StochasticGradientDescent +
     # BaseUpdater for the SGD family)
     # ------------------------------------------------------------------
-    def _apply_updaters(self, params, updater_state, grads, iteration,
-                        lr_scale_host):
-        """LR schedule + per-layer updater math + parameter update — the
-        tail every optimizer-step variant (plain, accumulated) shares."""
+    def _lr_scale(self, iteration, lr_scale_host):
+        """Effective LR multiplier for ``iteration``: the schedule's
+        policy scale times the host scale (``halve_lr`` knob). Shared by
+        the updater apply and the telemetry pack's lr-scale column."""
         gc = self.conf.global_conf
-        scale = lr_policy_scale(
+        return lr_policy_scale(
             gc.lr_policy, iteration, gc.lr_policy_decay_rate,
             gc.lr_policy_steps, gc.lr_policy_power, gc.lr_schedule,
             base_lr=gc.learning_rate,
         ) * lr_scale_host
+
+    def _apply_updaters(self, params, updater_state, grads, iteration,
+                        lr_scale_host):
+        """LR schedule + per-layer updater math + parameter update — the
+        tail every optimizer-step variant (plain, accumulated) shares."""
+        scale = self._lr_scale(iteration, lr_scale_host)
         new_params, new_updater = {}, {}
         for i, spec in enumerate(self.updater_specs):
             si = str(i)
@@ -364,6 +372,54 @@ class MultiLayerNetwork:
                 ok, apply, skip, None)
         return new_params, new_updater, new_nst, loss, ~ok
 
+    def _telemetry_step_impl(self, params, updater_state, net_state,
+                             iteration, lr_scale_host, x, y, feature_mask,
+                             label_mask, rng, accum_steps: int,
+                             guard: bool, metrics_stride: int):
+        """Fused-path step with the in-program metrics pack: the exact
+        math of the plain/accumulated/guarded step (branch for branch, so
+        telemetry-on params stay bitwise-identical to telemetry-off),
+        plus a ``[4]`` f32 diagnostics vector per step — grad global-norm,
+        applied-update global-norm, param global-norm, effective lr scale
+        (``monitor.pack.step_metrics``). Returns ``(params, updater,
+        net_state, loss, tripped-or-None, metrics)``."""
+        from deeplearning4j_tpu.monitor.pack import step_metrics
+        from deeplearning4j_tpu.resilience.guard import tree_all_finite
+
+        with dtypes_mod.policy_scope(self._policy):
+            if accum_steps > 1:
+                grads, loss, nst2 = self._accum_loss_grads(
+                    params, net_state, x, y, feature_mask, label_mask,
+                    rng, accum_steps)
+            else:
+                (loss, (nst2, _)), grads = self._loss_grads(
+                    params, net_state, x, y, feature_mask, label_mask,
+                    rng)
+            if guard:
+                ok = jnp.isfinite(loss) & tree_all_finite(grads)
+
+                def apply(_):
+                    p2, u2 = self._apply_updaters(
+                        params, updater_state, grads, iteration,
+                        lr_scale_host)
+                    return p2, u2, nst2
+
+                def skip(_):
+                    return params, updater_state, net_state
+
+                new_params, new_updater, new_nst = jax.lax.cond(
+                    ok, apply, skip, None)
+                tripped = ~ok
+            else:
+                new_params, new_updater = self._apply_updaters(
+                    params, updater_state, grads, iteration,
+                    lr_scale_host)
+                new_nst, tripped = nst2, None
+            m = step_metrics(params, new_params, grads,
+                             self._lr_scale(iteration, lr_scale_host),
+                             iteration, metrics_stride)
+        return new_params, new_updater, new_nst, loss, tripped, m
+
     @functools.cached_property
     def _train_step(self):
         return jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
@@ -491,6 +547,8 @@ class MultiLayerNetwork:
         self._score = loss
         self._last_input = ds.features
         self._train_dispatches += 1
+        record_counter("train_dispatches_total", model="MultiLayerNetwork",
+                       path="fit_steps")
         self.iteration_count += total
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
@@ -502,7 +560,7 @@ class MultiLayerNetwork:
     # fit_steps' single-batch fusion — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
     def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1,
-                      guard: bool = False):
+                      guard: bool = False, metrics_stride: int = 0):
         """The PURE chunk program: chunk_epochs x n_batches optimizer steps
         — outer ``lax.scan`` over epoch keys (each epoch derives a
         device-side ``jax.random.permutation`` batch order + per-batch step
@@ -512,11 +570,14 @@ class MultiLayerNetwork:
         gathering batches from the resident ``[N, B, ...]`` stacks.
         ``accum_steps > 1`` routes each batch through the microbatched
         accumulation step. ``guard=True`` routes each step through the
-        numeric sentinel (``_guarded_step_impl``) and returns ``(params,
-        updater, net_state, [E, N] hist, [E, N] trips)``; unguarded the
-        trips slot is absent: ``(params, updater, net_state, hist)``.
-        Shared verbatim by the single-device jit and ``ParallelWrapper``'s
-        SPMD jit (which pins out_shardings)."""
+        numeric sentinel (``_guarded_step_impl``); ``metrics_stride > 0``
+        compiles the in-program metrics pack in (``_telemetry_step_impl``
+        — an extra ``[E, N, 4]`` diagnostics history). Outputs, in order:
+        ``(params, updater, net_state, [E, N] hist[, [E, N] trips][,
+        [E, N, 4] metrics])`` — trips present iff guarded, metrics
+        present iff the pack is compiled in. Shared verbatim by the
+        single-device jit and ``ParallelWrapper``'s SPMD jit (which pins
+        out_shardings)."""
 
         def run(params, updater_state, net_state, iteration0, lr_scale_host,
                 xs, ys, fms, lms, epoch_keys):
@@ -532,6 +593,12 @@ class MultiLayerNetwork:
                     args = (params, upd, nst, it, lr_scale_host,
                             xs[i], ys[i],
                             None if fms is None else fms[i], lms[i], rng)
+                    if metrics_stride:
+                        p2, u2, s2, loss, tripped, m = (
+                            self._telemetry_step_impl(
+                                *args, accum_steps, guard, metrics_stride))
+                        out = (loss, tripped, m) if guard else (loss, m)
+                        return (p2, u2, s2, it + 1), out
                     if guard:
                         p2, u2, s2, loss, tripped = self._guarded_step_impl(
                             *args, accum_steps)
@@ -549,22 +616,29 @@ class MultiLayerNetwork:
 
             carry0 = (params, updater_state, net_state, iteration0)
             (p, u, s, _), hist = jax.lax.scan(epoch_body, carry0, epoch_keys)
+            if guard and metrics_stride:
+                losses, trips, mets = hist
+                return p, u, s, losses, trips, mets
             if guard:
                 losses, trips = hist
                 return p, u, s, losses, trips
+            if metrics_stride:
+                losses, mets = hist
+                return p, u, s, losses, mets
             return p, u, s, hist
 
         return run
 
     def _epoch_train_step(self, shuffle: bool, accum_steps: int = 1,
-                          guard: bool = False):
+                          guard: bool = False, metrics_stride: int = 0):
         """Jitted fused epoch program (one entry per (shuffle, accum,
-        guard)); params/updater/net state are donated; the dataset stacks
-        are NOT (they stay in HBM across chunks)."""
-        key = (shuffle, accum_steps, guard)
+        guard, metrics_stride)); params/updater/net state are donated; the
+        dataset stacks are NOT (they stay in HBM across chunks)."""
+        key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard),
+            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard,
+                                            metrics_stride),
                          donate_argnums=(0, 1, 2))
             self._epoch_steps[key] = fn
         return fn
@@ -609,7 +683,8 @@ class MultiLayerNetwork:
                    chunk_epochs: Optional[int] = None,
                    cache_mb: Optional[float] = None, mesh=None,
                    accum_steps: Optional[int] = None,
-                   guard: Optional[str] = None, on_chunk=None):
+                   guard: Optional[str] = None, telemetry=None,
+                   on_chunk=None):
         """``fit(iterator)`` for ``num_epochs`` epochs with the dataset
         cached in HBM and the whole training run fused: E epochs x N batches
         execute as ONE donated XLA program per chunk (`lax.scan` over a
@@ -648,6 +723,16 @@ class MultiLayerNetwork:
         (True stops the run) — the preemption-safe checkpoint hook. The
         per-step fallback paths are NOT sentinel-guarded.
 
+        Telemetry: ``telemetry`` (default: the ``DL4J_TELEMETRY`` /
+        ``DL4J_TELEMETRY_STRIDE`` env resolution — off unless opted in)
+        compiles the in-program metrics pack into the fused step: an
+        ``[E, N, 4]`` history of grad/update/param global-norms + lr
+        scale lands in ``self._last_metrics`` and flows to listeners'
+        ``chunk_done`` per chunk. ``False``/``0`` compiles it out
+        (bitwise the pre-telemetry program), ``True``/an int selects the
+        stride. The pack is observational — params are bitwise-identical
+        either way.
+
         Fallbacks (same matrix as ``fit_steps``): non-SGD solvers, TBPTT,
         pretraining, the score-reactive LR policy, and ``iterations > 1``
         run the plain per-step loop; datasets over the HBM budget
@@ -682,7 +767,8 @@ class MultiLayerNetwork:
             self._place_replicated(cache.mesh)
         guard = nan_guard_policy() if guard is None else guard
         guarded = guard != "off"
-        step = self._epoch_train_step(shuffle, accum, guarded)
+        stride = fused_metrics_stride(telemetry)
+        step = self._epoch_train_step(shuffle, accum, guarded, stride)
 
         def launch(epoch_keys):
             out = step(
@@ -691,12 +777,11 @@ class MultiLayerNetwork:
                 jnp.asarray(self._lr_scale_host, jnp.float32),
                 cache.features, cache.labels, cache.features_mask,
                 cache.labels_mask, epoch_keys)
-            if guarded:
-                (self.params, self.updater_state, self.net_state,
-                 hist, trips) = out
-                return hist, trips
-            (self.params, self.updater_state, self.net_state, hist) = out
-            return hist, None
+            (self.params, self.updater_state, self.net_state) = out[:3]
+            hist = out[3]
+            trips = out[4] if guarded else None
+            mets = out[-1] if stride else None
+            return hist, trips, mets
 
         def replay_step(params, upd, nst, it, i, rng):
             # per-step replay for DL4J_NAN_GUARD=raise localization: the
@@ -722,6 +807,8 @@ class MultiLayerNetwork:
 
     def _sgd_step(self, ds, rnn_state=None):
         self._train_dispatches += 1
+        record_counter("train_dispatches_total", model="MultiLayerNetwork",
+                       path="per_step")
         self._rng, rng = jax.random.split(self._rng)
         (self.params, self.updater_state, self.net_state, new_rnn, loss) = (
             self._train_step(
@@ -1047,6 +1134,8 @@ class MultiLayerNetwork:
                                  pad_axis0(x, b), pad_axis0(y, b), lm)
         if cm is not None:
             self._eval_readbacks += 1
+            record_counter("eval_readbacks_total",
+                           model="MultiLayerNetwork", kind="confusion")
             ev.eval_confusion(np.asarray(cm))  # the one host transfer
         return ev
 
@@ -1069,6 +1158,8 @@ class MultiLayerNetwork:
             sums = init_regression_sums(0)
         else:
             self._eval_readbacks += 1
+            record_counter("eval_readbacks_total",
+                           model="MultiLayerNetwork", kind="regression")
         return RegressionStats(jax.device_get(sums))
 
     @functools.cached_property

@@ -5,6 +5,19 @@ ScoreIterationListener.java (score log every N iters) and
 ParamAndGradientIterationListener.java (per-param stats to file). Listeners
 fire host-side after each jitted step; anything they read (score, param
 norms) forces a device sync, so heavyweight listeners should run at a stride.
+
+Fused-path protocol: the whole-epoch pipeline runs k epochs x N steps as
+ONE dispatch, so per-step ``iteration_done`` firings do not exist there.
+Instead the chunk driver calls ``chunk_done(model, iteration0, losses,
+metrics=)`` once per chunk with the chunk's DEVICE loss history (``[k,
+N]``) and, when telemetry is on, the ``[k, N, 4]`` metrics-pack history —
+``iteration0`` is the global iteration count BEFORE the chunk, so
+listeners reconstruct exact per-step iteration numbers across chunks and
+across preemption/resume. The base-class default keeps the legacy
+behavior (one ``iteration_done`` at the chunk's final count); listeners
+that want per-step granularity override it and pay ONE host sync per
+chunk for the whole history instead of E*N per-step ``score_value``
+syncs.
 """
 
 from __future__ import annotations
@@ -22,9 +35,23 @@ class IterationListener:
     def iteration_done(self, model, iteration: int) -> None:
         raise NotImplementedError
 
+    def chunk_done(self, model, iteration0: int, losses,
+                   metrics=None) -> None:
+        """A fused epoch chunk finished: ``losses`` is the chunk's
+        ``[k, N]`` loss history (device array — converting it syncs),
+        ``iteration0`` the global iteration count before the chunk,
+        ``metrics`` the optional ``[k, N, 4]`` metrics-pack history.
+        Default: the legacy once-per-chunk ``iteration_done`` firing."""
+        self.iteration_done(model, model.iteration_count)
+
 
 class ScoreIterationListener(IterationListener):
-    """Logs score every ``print_iterations`` (ScoreIterationListener.java)."""
+    """Logs score every ``print_iterations`` (ScoreIterationListener.java).
+
+    On the fused path ``chunk_done`` replays the chunk's loss history at
+    the same stride with exact global iteration numbers — one device sync
+    per chunk, not per step, and no dependence on ``model.score_value``
+    (which only holds the chunk's LAST loss)."""
 
     def __init__(self, print_iterations: int = 10, printer: Optional[Callable] = None):
         self.print_iterations = max(1, int(print_iterations))
@@ -34,6 +61,13 @@ class ScoreIterationListener(IterationListener):
         if iteration % self.print_iterations == 0:
             self.printer(f"Score at iteration {iteration} is {model.score_value}")
 
+    def chunk_done(self, model, iteration0, losses, metrics=None):
+        flat = np.asarray(losses).reshape(-1)  # the one sync per chunk
+        for j, loss in enumerate(flat):
+            it = iteration0 + j + 1
+            if it % self.print_iterations == 0:
+                self.printer(f"Score at iteration {it} is {float(loss)}")
+
 
 class ComposableIterationListener(IterationListener):
     def __init__(self, *listeners: IterationListener):
@@ -42,6 +76,14 @@ class ComposableIterationListener(IterationListener):
     def iteration_done(self, model, iteration):
         for l in self.listeners:
             l.iteration_done(model, iteration)
+
+    def chunk_done(self, model, iteration0, losses, metrics=None):
+        for l in self.listeners:
+            cb = getattr(l, "chunk_done", None)
+            if cb is not None:
+                cb(model, iteration0, losses, metrics=metrics)
+            else:
+                l.iteration_done(model, model.iteration_count)
 
 
 class CheckpointIterationListener(IterationListener):
@@ -133,6 +175,18 @@ class TimeIterationListener(IterationListener):
         if self.count == self.warmup:
             self.start_time = time.perf_counter()
 
+    def chunk_done(self, model, iteration0, losses, metrics=None):
+        # shape-only accounting: a [k, N] history is k*N steps and the
+        # shape is known without a device sync. The first chunk is the
+        # warm-up boundary (it carries the XLA compile).
+        shape = getattr(losses, "shape", None) or ()
+        n = int(np.prod(shape)) if shape else 1
+        if self.start_time is None:
+            self.start_time = time.perf_counter()
+            self.count = self.warmup
+        else:
+            self.count += n
+
     def steps_per_second(self) -> float:
         if self.start_time is None or self.count <= self.warmup:
             return 0.0
@@ -211,3 +265,12 @@ class CollectScoresIterationListener(IterationListener):
     def iteration_done(self, model, iteration):
         if iteration % self.frequency == 0:
             self.scores.append((iteration, model.score_value))
+
+    def chunk_done(self, model, iteration0, losses, metrics=None):
+        # per-step scores from the chunk history — previously the fused
+        # path could only append the chunk's last loss
+        flat = np.asarray(losses).reshape(-1)
+        for j, loss in enumerate(flat):
+            it = iteration0 + j + 1
+            if it % self.frequency == 0:
+                self.scores.append((it, float(loss)))

@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from deeplearning4j_tpu.monitor import metrics, record_counter, tracer
 from deeplearning4j_tpu.parallel.statetracker import StateTracker
 from deeplearning4j_tpu.resilience import RetryError, RetryPolicy, faults
 from deeplearning4j_tpu.resilience.preemption import PreemptionGuard
@@ -255,6 +256,12 @@ class FaultTolerantTrainer:
         """``"ok"`` (manifest matches), ``"unverified"`` (no manifest —
         legacy writer), or ``"corrupt"`` (size/hash mismatch, i.e. a
         partial write or bit-rot)."""
+        with tracer().span("checkpoint.verify",
+                           path=os.path.basename(path)) as sp:
+            sp.attrs["verdict"] = verdict = self._verify_impl(path)
+        return verdict
+
+    def _verify_impl(self, path: str) -> str:
         try:
             with open(self._manifest_path(path)) as f:
                 manifest = json.load(f)
@@ -274,21 +281,32 @@ class FaultTolerantTrainer:
         """Serialize ``model`` (live network or host snapshot) to
         ``path`` with the full integrity ritual: tmp + rename, manifest
         sidecar, prune, tracker pointer. Runs on the caller's thread for
-        ``save`` and on the writer thread for ``save_async``."""
+        ``save`` and on the writer thread for ``save_async``. Write
+        latency lands in the ``checkpoint_write_seconds`` histogram and a
+        ``checkpoint.write`` span — the signal that tells a slow shared
+        filesystem apart from a wedged chunk."""
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
-        tmp = path + ".tmp"
-        ModelSerializer.write_model(model, tmp, save_updater=True)
-        os.replace(tmp, path)
-        self._write_manifest(path, model.iteration_count)
-        for old in self.checkpoints()[:-self.keep]:
-            os.unlink(old)
-            try:
-                os.unlink(self._manifest_path(old))
-            except FileNotFoundError:
-                pass  # legacy checkpoint without a sidecar
-        if self.tracker is not None:
-            self.tracker.put_meta("latest_checkpoint", path)
+        with tracer().span("checkpoint.write",
+                           path=os.path.basename(path),
+                           iteration=model.iteration_count) as sp:
+            tmp = path + ".tmp"
+            ModelSerializer.write_model(model, tmp, save_updater=True)
+            os.replace(tmp, path)
+            self._write_manifest(path, model.iteration_count)
+            for old in self.checkpoints()[:-self.keep]:
+                os.unlink(old)
+                try:
+                    os.unlink(self._manifest_path(old))
+                except FileNotFoundError:
+                    pass  # legacy checkpoint without a sidecar
+            if self.tracker is not None:
+                self.tracker.put_meta("latest_checkpoint", path)
+        metrics().histogram(
+            "checkpoint_write_seconds",
+            "zip + sha256 manifest + prune wall time").observe(
+            sp.duration_s)
+        record_counter("checkpoint_saves_total")
         return path
 
     def save(self) -> str:
@@ -337,7 +355,14 @@ class FaultTolerantTrainer:
         joins it. Writes are serialized on one thread, so a slow disk
         backs saves up instead of corrupting them."""
         faults.fault_point("checkpoint.save")
-        snap = self._snapshot_model()
+        # the snapshot is the only part the host BLOCKS on — its span is
+        # the "how long did save_async stall training" answer
+        with tracer().span("checkpoint.snapshot") as sp:
+            snap = self._snapshot_model()
+        metrics().histogram(
+            "checkpoint_snapshot_seconds",
+            "device->host state copy (the blocking part of save_async)"
+        ).observe(sp.duration_s)
         if snap is None:  # model type without the snapshot surface
             fut: concurrent.futures.Future = concurrent.futures.Future()
             try:
@@ -410,6 +435,10 @@ class FaultTolerantTrainer:
         (``build_epoch_cache(mesh=...)`` / ``ParallelWrapper``), which
         replicates-and-streams cleanly when the batch axis no longer
         divides the new width."""
+        with tracer().span("checkpoint.resume") as resume_span:
+            return self._resume_impl(mesh, fsdp, resume_span)
+
+    def _resume_impl(self, mesh, fsdp: bool, resume_span) -> bool:
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
         candidates = self._resume_candidates()
@@ -447,7 +476,11 @@ class FaultTolerantTrainer:
             if saw_corrupt:
                 logger.warning("resumed from fallback %s (skipped %d bad "
                                "checkpoint(s))", path, len(saw_corrupt))
+            resume_span.attrs.update(restored=os.path.basename(path),
+                                     skipped=len(saw_corrupt))
+            record_counter("checkpoint_resumes_total", outcome="restored")
             return True
+        resume_span.attrs["skipped"] = len(saw_corrupt)
         if saw_corrupt:
             raise RuntimeError(
                 f"all {len(saw_corrupt)} checkpoint(s) under {self.dir} "

@@ -64,7 +64,7 @@ class ParallelWrapper:
         self.mesh = mesh or build_mesh()
         self._donate = donate
         self.fsdp = fsdp
-        self._epoch_steps = {}  # fused SPMD epoch program per (shuffle, K)
+        self._epoch_steps = {}  # fused SPMD epoch program per (shuffle, K, guard, stride)
         network._ensure_init()
         self._place_params()
 
@@ -223,14 +223,16 @@ class ParallelWrapper:
             data, mesh=self.mesh, accum_steps=accum_steps)
 
     def _epoch_program(self, shuffle: bool, accum_steps: int,
-                       guard: bool = False):
+                       guard: bool = False, metrics_stride: int = 0):
         """The network's pure chunk program jitted for SPMD execution:
         out_shardings pinned so donated params/updater state STAY
         replicated (or FSDP-sharded) across chunks instead of whatever
         the partitioner would pick. With the numeric sentinel compiled in
-        (``guard``) the program returns a fifth output — the ``[E, N]``
-        trip history — replicated like the loss history."""
-        key = (shuffle, accum_steps, guard)
+        (``guard``) the program returns an extra output — the ``[E, N]``
+        trip history — replicated like the loss history; the telemetry
+        metrics pack (``metrics_stride``) appends another replicated
+        ``[E, N, 4]`` output after it."""
+        key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
             repl = NamedSharding(self.mesh, P())
@@ -241,8 +243,10 @@ class ParallelWrapper:
                 out = (repl, repl, repl, repl)
             if guard:
                 out = out + (repl,)
+            if metrics_stride:
+                out = out + (repl,)
             fn = jax.jit(self.network._epoch_run_fn(shuffle, accum_steps,
-                                                    guard),
+                                                    guard, metrics_stride),
                          donate_argnums=(0, 1, 2) if self._donate else (),
                          out_shardings=out)
             self._epoch_steps[key] = fn
@@ -251,7 +255,8 @@ class ParallelWrapper:
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
                    accum_steps: Optional[int] = None,
-                   guard: Optional[str] = None, on_chunk=None):
+                   guard: Optional[str] = None, telemetry=None,
+                   on_chunk=None):
         """``fit_epochs`` as ONE donated SPMD program per epoch chunk:
         E epochs x N batches of `lax.scan` with the batch axis sharded
         over the mesh ``data`` axis, params/updater replicated (or
@@ -259,13 +264,17 @@ class ParallelWrapper:
         the unsharded batch-index axis (shard-local gathers, no
         resharding collective) and GSPMD inserting one gradient
         all-reduce per step. ``accum_steps=K`` scans K microbatches per
-        updater apply. Returns the ``[E, N]`` loss history, or ``None``
+        updater apply; ``telemetry=`` compiles the in-program metrics
+        pack in (an extra replicated ``[E, N, 4]`` output — see
+        MultiLayerNetwork.fit_epochs). Returns the ``[E, N]`` loss
+        history, or ``None``
         when a fallback ran (unsupported config -> the network's own
         fallback matrix; over-budget dataset -> per-batch streaming
         through ``AsyncDataSetIterator`` device prefetch — sharded via
         the wrapper's step for MultiLayerNetwork, the network's own
         single-device fit for ComputationGraph, which does not speak the
         per-batch sharded-step protocol)."""
+        from deeplearning4j_tpu.monitor import fused_metrics_stride
         from deeplearning4j_tpu.perf.epoch_cache import (
             DeviceDataSetCache, DeviceMultiDataSetCache,
             accum_steps_default, drive_epoch_chunks, effective_accum_steps,
@@ -320,7 +329,8 @@ class ParallelWrapper:
         multi = isinstance(cache, DeviceMultiDataSetCache)
         guard = nan_guard_policy() if guard is None else guard
         guarded = guard != "off"
-        step = self._epoch_program(shuffle, accum, guarded)
+        stride = fused_metrics_stride(telemetry)
+        step = self._epoch_program(shuffle, accum, guarded, stride)
 
         def launch(epoch_keys):
             with self.mesh:
@@ -338,12 +348,11 @@ class ParallelWrapper:
                         jnp.asarray(net._lr_scale_host, jnp.float32),
                         cache.features, cache.labels, cache.features_mask,
                         cache.labels_mask, epoch_keys)
-            if guarded:
-                (net.params, net.updater_state, net.net_state,
-                 hist, trips) = out
-                return hist, trips
-            (net.params, net.updater_state, net.net_state, hist) = out
-            return hist, None
+            (net.params, net.updater_state, net.net_state) = out[:3]
+            hist = out[3]
+            trips = out[4] if guarded else None
+            mets = out[-1] if stride else None
+            return hist, trips, mets
 
         def replay_step(params, upd, nst, it, i, rng):
             # DL4J_NAN_GUARD=raise localization replays through the

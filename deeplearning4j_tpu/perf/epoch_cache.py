@@ -247,6 +247,13 @@ class DeviceDataSetCache:
     def build(cls, data, budget_mb: Optional[float] = None,
               buckets: Optional[Sequence[int]] = None, mesh=None,
               accum_steps: int = 1) -> Optional["DeviceDataSetCache"]:
+        return _traced_build(cls, data, budget_mb, buckets, mesh,
+                             accum_steps)
+
+    @classmethod
+    def _build(cls, data, budget_mb: Optional[float] = None,
+               buckets: Optional[Sequence[int]] = None, mesh=None,
+               accum_steps: int = 1) -> Optional["DeviceDataSetCache"]:
         budget = cache_budget_mb() if budget_mb is None else float(budget_mb)
         if budget <= 0:
             return None
@@ -353,6 +360,13 @@ class DeviceMultiDataSetCache:
     def build(cls, data, budget_mb: Optional[float] = None,
               buckets: Optional[Sequence[int]] = None, mesh=None,
               accum_steps: int = 1) -> Optional["DeviceMultiDataSetCache"]:
+        return _traced_build(cls, data, budget_mb, buckets, mesh,
+                             accum_steps)
+
+    @classmethod
+    def _build(cls, data, budget_mb: Optional[float] = None,
+               buckets: Optional[Sequence[int]] = None, mesh=None,
+               accum_steps: int = 1) -> Optional["DeviceMultiDataSetCache"]:
         from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
         budget = cache_budget_mb() if budget_mb is None else float(budget_mb)
@@ -439,6 +453,26 @@ class DeviceMultiDataSetCache:
                    nbytes=nbytes, mesh=mesh, n_shard=n_shard)
 
 
+def _traced_build(cls, data, budget_mb, buckets, mesh, accum_steps):
+    """``cache.build`` span around either cache class's ``_build``: the
+    drain + pad + host->device transfer is the fused pipeline's one big
+    serial host cost, so its duration (and whether it fell back to
+    streaming) belongs on the timeline."""
+    from deeplearning4j_tpu.monitor import record_counter, tracer
+
+    with tracer().span("cache.build", kind=cls.__name__) as sp:
+        out = cls._build(data, budget_mb=budget_mb, buckets=buckets,
+                         mesh=mesh, accum_steps=accum_steps)
+        sp.attrs["cached"] = out is not None
+        if out is not None:
+            sp.attrs.update(n_batches=out.n_batches, batch=out.batch,
+                            mb=round(out.nbytes / 1024 ** 2, 3),
+                            n_shard=out.n_shard)
+    record_counter("cache_builds_total", kind=cls.__name__,
+                   outcome="cached" if out is not None else "fallback")
+    return out
+
+
 def chunk_deadline_s(chunk_steps: int) -> float:
     """StepWatchdog deadline for one fused chunk dispatch, scaled by the
     number of fused optimizer steps it contains. ``DL4J_STEP_DEADLINE_S``
@@ -463,11 +497,23 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     """The shared host-side chunk driver behind both classes' fit_epochs:
     splits the net's RNG into per-chunk epoch keys, launches each fused
     chunk (``launch_chunk(epoch_keys) -> ([k, N] hist, [k, N] trips or
-    None)`` updates the net's params/updater/net state itself), advances
-    the iteration count by k*N, and fires listeners once per chunk — the
-    host decision point. Default chunking: whole run without listeners,
-    one epoch with them. Returns the concatenated ``[E, N]`` loss
-    history.
+    None, [k, N, 4] metrics or None)`` updates the net's params/updater/
+    net state itself), advances the iteration count by k*N, and fires
+    listeners once per chunk — the host decision point. Default chunking:
+    whole run without listeners, one epoch with them. Returns the
+    concatenated ``[E, N]`` loss history.
+
+    Telemetry (the observability bus around the fast path): every chunk
+    dispatch runs inside an ``epoch.chunk`` tracer span (and bumps the
+    ``train_chunk_dispatches_total`` counter); per-chunk host readbacks
+    get ``epoch.readback`` spans; the metrics-pack history (when the
+    chunk program carries one) accumulates device-side — zero extra
+    syncs — and lands in ``net._last_metrics`` as ``[E, N, 4]`` at end
+    of run. Listeners implementing ``chunk_done(model, iteration0,
+    losses, metrics=)`` receive each chunk's DEVICE histories with the
+    chunk's global starting iteration (correct numbering across chunks
+    and resume); listeners without it keep the legacy once-per-chunk
+    ``iteration_done`` firing.
 
     Self-healing hooks (the robustness layer around the fast path):
 
@@ -499,15 +545,19 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.monitor import record_counter, tracer
     from deeplearning4j_tpu.resilience import faults
     from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 
     if chunk_epochs is None:
         chunk_epochs = 1 if net.listeners else num_epochs
     chunk_epochs = max(1, min(int(chunk_epochs), num_epochs))
+    model_name = type(net).__name__
     history = []
     sentinel_chunks = []
+    metrics_chunks = []
     net._last_sentinel = None
+    net._last_metrics = None
     # skip takes no per-chunk action — keep its trip reads off the hot
     # path (device arrays accumulate; one sync at end of run)
     defer_inspect = guard not in ("halve_lr", "raise")
@@ -531,11 +581,22 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                         jax.tree_util.tree_map(jnp.copy, t)
                         for t in (net.params, net.updater_state,
                                   net.net_state))
-                hist, trips = launch_chunk(keys[1:])
+                # the span times the HOST-side dispatch (the XLA launch
+                # returns before the chunk completes; completion shows up
+                # in the next blocking read's epoch.readback span)
+                with tracer().span("epoch.chunk", model=model_name,
+                                   epochs=k,
+                                   steps=k * cache.n_batches,
+                                   epoch0=done):
+                    hist, trips, mets = launch_chunk(keys[1:])
                 watchdog.beat()
                 net._train_dispatches += 1
+                record_counter("train_chunk_dispatches_total",
+                               model=model_name)
                 net.iteration_count += k * cache.n_batches
                 net._score = hist[-1, -1]  # device scalar
+                if mets is not None:
+                    metrics_chunks.append(mets)  # device; no sync
                 if trips is not None:
                     if defer_inspect:
                         sentinel_chunks.append(trips)  # device; no sync
@@ -543,7 +604,9 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                         # halve_lr/raise act between chunks: this read
                         # blocks on the chunk's completion — the one
                         # host sync those policies cost per chunk
-                        t = np.asarray(trips)
+                        with tracer().span("epoch.readback",
+                                           what="sentinel"):
+                            t = np.asarray(trips)
                         sentinel_chunks.append(t)
                         if t.any():
                             _enforce_nan_guard(net, guard, t, done,
@@ -553,15 +616,24 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                 history.append(hist)
                 done += k
                 for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration_count)
+                    chunk_cb = getattr(listener, "chunk_done", None)
+                    if chunk_cb is not None:
+                        chunk_cb(net, it0, hist, metrics=mets)
+                    else:  # pre-telemetry listener protocol
+                        listener.iteration_done(net, net.iteration_count)
                 if on_chunk is not None and on_chunk(done):
                     break
     finally:
         # flush even when the raise policy aborts the run mid-chunk: a
         # TrainingDivergedError handler reads the history that tripped it
+        if metrics_chunks:
+            net._last_metrics = (metrics_chunks[0]
+                                 if len(metrics_chunks) == 1
+                                 else jnp.concatenate(metrics_chunks))
         if sentinel_chunks:
-            full = np.concatenate([np.asarray(t)
-                                   for t in sentinel_chunks])
+            with tracer().span("epoch.readback", what="sentinel_flush"):
+                full = np.concatenate([np.asarray(t)
+                                       for t in sentinel_chunks])
             net._last_sentinel = full
             if defer_inspect and full.any():
                 # the deferred skip-policy report (epoch indices are

@@ -81,12 +81,24 @@ _armed: bool = False
 
 def fault_point(name: str) -> None:
     """Declare a named injection site. No-op unless a schedule is
-    installed for ``name`` (zero overhead when the registry is empty)."""
+    installed for ``name`` (zero overhead when the registry is empty).
+    Armed sites count every evaluation in the metrics registry
+    (``fault_site_fires_total``, labeled raised=true/false) so a chaos
+    run's artifact shows which sites actually fired."""
     if not _armed:
         return
     sched = _active.get(name)
     if sched is not None:
-        sched(name)
+        from deeplearning4j_tpu.monitor import record_counter
+
+        try:
+            sched(name)
+        except BaseException:
+            record_counter("fault_site_fires_total", site=name,
+                           raised="true")
+            raise
+        record_counter("fault_site_fires_total", site=name,
+                       raised="false")
 
 
 class FaultPoint:

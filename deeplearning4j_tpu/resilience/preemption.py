@@ -57,6 +57,7 @@ class PreemptionGuard:
     def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
         self.signals = tuple(signals)
         self._requested = threading.Event()
+        self._pending_latch = None
         self._prev = {}
         self._installed = False
 
@@ -98,21 +99,36 @@ class PreemptionGuard:
         logger.warning("preemption signal %s received; will checkpoint "
                        "and stop at the next chunk boundary", signum)
         self._requested.set()
+        # telemetry is NOT recorded here: the handler runs on the main
+        # thread, and record_counter/tracer take non-reentrant locks the
+        # interrupted frame may already hold — a latch must never
+        # deadlock the preemption it reports. The next requested()/
+        # check() poll (every chunk boundary) flushes it.
+        self._pending_latch = ("signal", {"signum": signum})
         prev = self._prev.get(signum)
         if callable(prev):
             prev(signum, frame)
 
+    def _flush_pending_latch(self) -> None:
+        pending = self._pending_latch
+        if pending is not None:
+            self._pending_latch = None
+            _latch_telemetry(pending[0], **pending[1])
+
     def request(self) -> None:
         """Programmatic preemption (tests, cloud metadata watchers)."""
         self._requested.set()
+        _latch_telemetry("request")
 
     def requested(self) -> bool:
+        self._flush_pending_latch()
         return self._requested.is_set()
 
     def check(self) -> bool:
         """Poll both trigger paths; returns True once preemption has been
         requested. An injected fault at ``preempt.chunk`` counts as a
         request (the injection IS the preemption notice)."""
+        self._flush_pending_latch()
         if not self._requested.is_set():
             try:
                 faults.fault_point(PREEMPT_CHUNK_SITE)
@@ -121,4 +137,17 @@ class PreemptionGuard:
                                "checkpoint and stop at this chunk "
                                "boundary", PREEMPT_CHUNK_SITE)
                 self._requested.set()
+                _latch_telemetry("fault")
         return self._requested.is_set()
+
+
+def _latch_telemetry(source: str, **attrs) -> None:
+    """Count + timeline-stamp a preemption latch. Best-effort: it can run
+    inside a signal handler, where telemetry must never raise."""
+    try:
+        from deeplearning4j_tpu.monitor import record_counter, tracer
+
+        record_counter("preemption_latches_total", source=source)
+        tracer().event("preemption.latch", source=source, **attrs)
+    except Exception:  # noqa: BLE001
+        pass

@@ -130,13 +130,23 @@ class RetryPolicy:
                         f"{attempt} attempt(s)"
                         + (" (deadline exceeded)" if out_of_time else "")
                         + f": {e}", last=e, attempts=attempt) from e
+                fname = getattr(fn, "__name__", repr(fn))
                 if self.on_retry is not None:
                     self.on_retry(attempt, e, wait)
                 else:
                     logger.debug("retry %d of %r in %.3fs after %s",
-                                 attempt, getattr(fn, "__name__", fn),
-                                 wait, e)
-                self.sleep(wait)
+                                 attempt, fname, wait, e)
+                # telemetry: every retry counts, every backoff sleep is a
+                # span — a run that spent its wall clock backing off shows
+                # it on the timeline instead of looking wedged
+                from deeplearning4j_tpu.monitor import (record_counter,
+                                                        tracer)
+
+                record_counter("retry_attempts_total", fn=fname)
+                with tracer().span("retry.sleep", fn=fname,
+                                   attempt=attempt,
+                                   delay_s=round(wait, 4)):
+                    self.sleep(wait)
 
     def retrying(self, fn: Callable) -> Callable:
         """Decorator form of :meth:`call`."""

@@ -96,6 +96,15 @@ class StepWatchdog:
                 last_fire = self._clock()
                 self.stalls += 1
                 try:
+                    # telemetry first: even an on_stall that aborts the
+                    # process leaves the stall on the timeline
+                    from deeplearning4j_tpu.monitor import (
+                        record_counter, tracer)
+
+                    record_counter("watchdog_stalls_total")
+                    tracer().event("watchdog.stall",
+                                   stalled_s=round(stalled, 3),
+                                   deadline_s=self.deadline_s)
                     self.on_stall(stalled)
                 except Exception:  # noqa: BLE001 — callback must not
                     logger.exception("StepWatchdog on_stall raised")

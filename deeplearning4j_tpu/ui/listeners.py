@@ -67,10 +67,24 @@ class _UiListener(IterationListener):
         self.frequency = max(1, int(frequency))
 
     def _post(self, kind_route: str, kind: str, payload: Any) -> None:
+        payload = _json_sanitize(payload)
         if self._conn is not None:
             self._conn.post(kind_route, payload, self.session_id)
         else:
             self._server.post_update(kind, payload, sid=self.session_id)
+
+
+def _json_sanitize(obj):
+    """Non-finite floats → None: a diverged loss or an off-stride NaN
+    metrics row must not make ``json.dumps`` emit the non-standard
+    ``NaN`` token that strict UI-side parsers reject."""
+    if isinstance(obj, float):
+        return obj if np.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_sanitize(v) for v in obj]
+    return obj
 
 
 def _array_stats(arr: np.ndarray) -> Dict[str, Any]:
@@ -89,15 +103,21 @@ def _array_stats(arr: np.ndarray) -> Dict[str, Any]:
 
 class HistogramIterationListener(_UiListener):
     """Param/update histograms + score → /weights/update
-    (HistogramIterationListener.java)."""
+    (HistogramIterationListener.java).
+
+    Fused path: ``chunk_done`` posts ONE update per chunk carrying the
+    chunk's per-step loss curve (and, when telemetry is on, the in-
+    program metrics-pack series — grad/update/param norms + lr scale),
+    so the UI score panel shows every fused step without per-step device
+    syncs."""
+
+    MAX_CURVE_POINTS = 512  # payload bound: long chunks downsample
 
     def __init__(self, frequency: int = 1, **kw):
         super().__init__(frequency=frequency, **kw)
         self._prev_table: Optional[Dict[str, np.ndarray]] = None
 
-    def iteration_done(self, model, iteration: int) -> None:
-        if iteration % self.frequency:
-            return
+    def _payload(self, model, iteration: int) -> Dict[str, Any]:
         table = {k: np.asarray(v) for k, v in model.get_param_table().items()}
         payload: Dict[str, Any] = {
             "iteration": iteration,
@@ -111,7 +131,52 @@ class HistogramIterationListener(_UiListener):
             }
             payload["gradients"] = updates  # applied update Δθ (see module doc)
         self._prev_table = table
+        return payload
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency:
+            return
+        self._post("/weights/update", "weights",
+                   self._payload(model, iteration))
+
+    def chunk_done(self, model, iteration0, losses, metrics=None) -> None:
+        # honor the stride like iteration_done: post only when the chunk
+        # window (iteration0, iteration0 + k*N] crosses a multiple of
+        # frequency. The gate reads only the SHAPE — an off-stride chunk
+        # must cost neither the loss-history readback nor the
+        # get_param_table() device→host sync
+        shape = getattr(losses, "shape", None) or ()
+        n = int(np.prod(shape)) if shape else 1
+        next_due = iteration0 + self.frequency - iteration0 % self.frequency
+        if next_due > iteration0 + n:
+            return
+        flat = np.asarray(losses, np.float64).reshape(-1)
+        payload = self._payload(model, model.iteration_count)
+        its, vals = _downsample(iteration0 + 1, flat,
+                                self.MAX_CURVE_POINTS)
+        payload["loss_history"] = {"iterations": its, "losses": vals}
+        if metrics is not None:
+            from deeplearning4j_tpu.monitor.pack import METRIC_NAMES
+
+            m = np.asarray(metrics, np.float64).reshape(
+                -1, len(METRIC_NAMES))
+            series = {}
+            for col, name in enumerate(METRIC_NAMES):
+                _, vals = _downsample(iteration0 + 1, m[:, col],
+                                      self.MAX_CURVE_POINTS)
+                series[name] = vals
+            payload["metrics_pack"] = {"iterations": its, **series}
         self._post("/weights/update", "weights", payload)
+
+
+def _downsample(it0: int, values: np.ndarray, max_points: int):
+    """(iterations, values) lists with at most ``max_points`` entries —
+    evenly strided so the curve's shape survives."""
+    n = len(values)
+    idx = (np.arange(n) if n <= max_points
+           else np.linspace(0, n - 1, max_points).round().astype(int))
+    return ([int(it0 + i) for i in idx],
+            [float(values[i]) for i in idx])
 
 
 class FlowIterationListener(_UiListener):

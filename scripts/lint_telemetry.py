@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Telemetry lint: no new bare ``_*_counter`` attributes outside monitor/.
+
+PR 6 absorbed the scattered ad-hoc counters behind
+``deeplearning4j_tpu.monitor.metrics()`` (and the ``record_counter``
+one-liner). This check keeps the door shut: any module other than
+``monitor/`` that assigns a ``self._<something>_counter`` attribute is
+growing a new off-registry counter and fails the lint.
+
+The two legacy per-instance counters (``_train_dispatches``,
+``_eval_readbacks``) predate the naming rule and are mirrored into the
+registry at every increment; they are intentionally NOT flagged (their
+names do not match the ``_*_counter`` pattern, and tests rely on the
+per-instance view).
+
+Usage: python scripts/lint_telemetry.py   (exit 0 clean, 1 violations)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# =(?!=) — assignment only, not `== ` comparisons
+PATTERN = re.compile(r"self\._[A-Za-z0-9_]*_counter\b\s*=(?!=)")
+PKG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                   "deeplearning4j_tpu")
+EXEMPT_DIR = "monitor"
+
+
+def main() -> int:
+    violations = []
+    for root, dirs, files in os.walk(os.path.abspath(PKG)):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        rel_root = os.path.relpath(root, os.path.abspath(PKG))
+        if rel_root.split(os.sep)[0] == EXEMPT_DIR:
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if PATTERN.search(line):
+                        violations.append(f"{path}:{lineno}: {line.strip()}")
+    if violations:
+        print("telemetry lint: bare _*_counter attributes outside "
+              "monitor/ — use monitor.record_counter()/metrics() "
+              "instead:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("telemetry lint: OK (no bare _*_counter attributes outside "
+          "monitor/)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

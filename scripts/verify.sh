@@ -20,9 +20,13 @@
 #                                      policies, preemption + bitwise
 #                                      elastic resume, save_async,
 #                                      checkpoint corruption/eviction)
-# The eval/epoch/dp/heal tests are part of the default tier-1 run;
-# --eval/--epoch/--dp/--heal are the narrow fast paths for iterating on
-# those surfaces.
+#        scripts/verify.sh --obs      (just the telemetry suite — metrics
+#                                      pack parity/values, registry,
+#                                      tracer, exporters — plus the
+#                                      no-bare-counters lint)
+# The eval/epoch/dp/heal/obs tests are part of the default tier-1 run;
+# --eval/--epoch/--dp/--heal/--obs are the narrow fast paths for
+# iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -40,6 +44,12 @@ elif [ "${1:-}" = "--dp" ]; then
 elif [ "${1:-}" = "--heal" ]; then
     shift
     TARGET="tests/test_self_healing.py tests/test_resilience.py tests/test_cluster.py"
+elif [ "${1:-}" = "--obs" ]; then
+    shift
+    TARGET=tests/test_telemetry.py
+    # the counters lint rides along with the telemetry suite: no module
+    # besides monitor/ may define new bare _*_counter attributes
+    python scripts/lint_telemetry.py || exit 1
 fi
 
 rm -f /tmp/_t1.log

@@ -262,10 +262,10 @@ class TestOneDispatchPerChunk:
         wrapper = ParallelWrapper(net, mesh=build_mesh())
         wrapper.fit_epochs(ListDataSetIterator(_ff_data(), 32), 2)
         wrapper.fit_epochs(ListDataSetIterator(_ff_data(), 32), 2)
-        assert set(wrapper._epoch_steps) == {(True, 1, True)}
+        assert set(wrapper._epoch_steps) == {(True, 1, True, 0)}
         wrapper.fit_epochs(ListDataSetIterator(_ff_data(), 32), 2,
                            accum_steps=4)
-        assert set(wrapper._epoch_steps) == {(True, 1, True), (True, 4, True)}
+        assert set(wrapper._epoch_steps) == {(True, 1, True, 0), (True, 4, True, 0)}
 
 
 class TestGradientAccumulation:
@@ -354,7 +354,7 @@ class TestGradientAccumulation:
         hist_b = base.fit_epochs(ListDataSetIterator(_ff_data(), 32), 2,
                                  accum_steps=1)
         hist_a = accum.fit_epochs(ListDataSetIterator(_ff_data(), 32), 2)
-        assert (True, 4, True) in accum._epoch_steps
+        assert (True, 4, True, 0) in accum._epoch_steps
         np.testing.assert_allclose(np.asarray(hist_a), np.asarray(hist_b),
                                    **TOL)
 
@@ -381,7 +381,7 @@ class TestRouting:
         assert result.total_epochs == 3
         assert net._train_dispatches == 3  # one SPMD program per epoch
         # the trainer's cache was mesh-sharded (built via the wrapper)
-        assert (True, 1, True) in wrapper._epoch_steps
+        assert (True, 1, True, 0) in wrapper._epoch_steps
 
     def test_streaming_fallback_routes_through_sharded_step(self):
         """Over budget even sharded -> per-batch streaming through the

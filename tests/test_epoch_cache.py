@@ -283,10 +283,14 @@ class TestDispatchAndChunking:
         net = _ff_net()
         lst = CollectScoresIterationListener()
         net.set_listeners(lst)
-        net.fit_epochs(ListDataSetIterator(_ff_data(), 32), 3)
-        # default chunk with listeners = 1 epoch → one firing per epoch,
-        # iteration_count jumping by N=4 each time
-        assert [it for it, _ in lst.scores] == [4, 8, 12]
+        hist = net.fit_epochs(ListDataSetIterator(_ff_data(), 32), 3)
+        # default chunk with listeners = 1 epoch → one chunk_done per
+        # epoch; the listener reconstructs EVERY step's (iteration,
+        # loss) from the chunk history (PR-6 fused listener protocol)
+        assert [it for it, _ in lst.scores] == list(range(1, 13))
+        np.testing.assert_allclose(
+            [s for _, s in lst.scores], np.asarray(hist).reshape(-1),
+            rtol=1e-6)
         assert net._train_dispatches == 3
 
     def test_explicit_chunking_concatenates_history(self):
@@ -302,7 +306,7 @@ class TestDispatchAndChunking:
         shape must add exactly one entry."""
         net = _ff_net()
         net.fit_epochs(ListDataSetIterator(_ff_data(100, seed=0), 32), 2)
-        step = net._epoch_steps[(True, 1, True)]
+        step = net._epoch_steps[(True, 1, True, 0)]
         assert step._cache_size() == 1
         net.fit_epochs(ListDataSetIterator(_ff_data(100, seed=7), 32), 2)
         assert step._cache_size() == 1  # same shapes: no new compile

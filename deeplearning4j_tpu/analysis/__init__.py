@@ -1,0 +1,54 @@
+"""Static analysis for the fused-training contract (ISSUE 7).
+
+Two prongs, one import-light package (stdlib at import time — jax loads
+lazily inside the contract checker only, so CLI/CI shells and pre-jax
+entry points can import this freely):
+
+- **dl4j-lint** (``engine``/``rules``/``baseline``): an AST rule engine
+  with inline suppressions and a checked-in baseline, shipping the
+  ruleset that machine-checks what PRs 3–6 only documented — no host
+  syncs in hot paths, hashable program-cache keys, single-use RNG keys,
+  locked cross-thread mutation, no reads after donation, registry-backed
+  counters, audited pytest markers. CLI: ``scripts/dl4j_lint.py``;
+  gate: ``scripts/verify.sh --lint``.
+- **program contracts** (``contracts``): jaxpr/StableHLO inspection of
+  every cached fused program — callback-free, donation applied,
+  collectives on declared axes, outputs matching the program key —
+  wired into tier-1 via tests/test_analysis.py.
+
+See docs/static_analysis.md for the rule catalog and workflows.
+"""
+
+from deeplearning4j_tpu.analysis.annotations import (  # noqa: F401
+    HOT_PATH_REGISTRY,
+    traced,
+)
+
+__all__ = [
+    "HOT_PATH_REGISTRY",
+    "traced",
+    "Finding",
+    "LintConfig",
+    "run_lint",
+    "check_network_contracts",
+    "ContractViolation",
+]
+
+# PEP 562: only the 4-line annotations marker loads eagerly — the
+# production modules that import @traced must not pay for the lint
+# engine (ast/tokenize), and contracts must not pull jax
+_LAZY = {
+    "Finding": "engine", "LintConfig": "engine", "run_lint": "engine",
+    "check_network_contracts": "contracts",
+    "ContractViolation": "contracts",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+
+        return getattr(importlib.import_module(
+            f"deeplearning4j_tpu.analysis.{mod}"), name)
+    raise AttributeError(name)

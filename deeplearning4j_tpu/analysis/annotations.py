@@ -1,0 +1,65 @@
+"""Hot-path annotations consumed by dl4j-lint (stdlib-only, zero cost).
+
+The fused training pipeline stakes correctness on a contract no test
+states directly: code reachable from a traced/jitted hot path must never
+touch the host (``float()``, ``.item()``, ``np.asarray``,
+``jax.device_get``, ``block_until_ready``) — one such call inside the
+whole-epoch program either breaks tracing outright or, worse, silently
+serializes E*N fused steps behind a device sync.
+
+``@traced`` marks a function as part of that surface.  It is a pure
+marker: the decorator returns the function unchanged (so it composes
+with ``jax.jit``, ``functools.cached_property`` and friends) and only
+sets ``__dl4j_traced__`` for runtime introspection.  The static analyzer
+(``analysis/rules.py``) does not import the code at all — it matches the
+decorator *name* in the AST — so ``@traced`` works equally on code that
+cannot import (fixture snippets, gated backends).
+
+``HOT_PATH_REGISTRY`` is the second prong: function names that are hot
+by convention, so pre-annotation code (and code we must not churn) is
+covered without edits.  Names are matched bare, module-independent —
+every ``_step_impl`` in the tree is a hot root, which is exactly right
+for the MLN/CG twin implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+__all__ = ["traced", "HOT_PATH_REGISTRY"]
+
+
+def traced(fn: F) -> F:
+    """Mark ``fn`` as running under ``jax.jit``/``lax.scan`` tracing (a
+    hot root for dl4j-lint's host-sync rule). Identity at runtime."""
+    fn.__dl4j_traced__ = True
+    return fn
+
+
+# Functions that are hot roots by NAME, wherever they are defined — the
+# fused-step twins on MultiLayerNetwork/ComputationGraph, the chunk
+# program factory (its nested ``run`` is hot by containment), the
+# device_eval kernels, and the traced helpers they lean on. Keep this
+# list in sync with docs/static_analysis.md.
+HOT_PATH_REGISTRY = frozenset({
+    # nn/multilayer.py + nn/graph.py fused-step surface
+    "_step_impl",
+    "_accum_step_impl",
+    "_guarded_step_impl",
+    "_telemetry_step_impl",
+    "_loss_grads",
+    "_accum_loss_grads",
+    "_epoch_run_fn",
+    # perf/epoch_cache.py — runs traced inside the chunk program
+    "epoch_schedule",
+    # perf/device_eval.py kernels (jitted inside the eval step)
+    "confusion_update",
+    "regression_update",
+    "_flatten_time",
+    # monitor/pack.py + resilience/guard.py traced helpers
+    "step_metrics",
+    "tree_global_norm",
+    "tree_all_finite",
+})

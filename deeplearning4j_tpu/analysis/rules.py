@@ -1,0 +1,1072 @@
+"""The dl4j-lint ruleset: machine checks for the fused-pipeline contract.
+
+Every rule here states an invariant PRs 3–6 rely on but no test asserts
+directly (see docs/static_analysis.md for the catalog with rationale):
+
+- ``host-sync-in-hot-path``   — no ``float()``/``.item()``/``np.asarray``/
+  ``jax.device_get``/``block_until_ready`` reachable from a traced hot
+  root (``@traced`` or ``HOT_PATH_REGISTRY``).
+- ``recompile-hazard``        — no unhashable / object-typed values in
+  jit program-cache keys (``_epoch_steps`` and friends).
+- ``rng-reuse``               — no ``jax.random`` key consumed twice
+  without an intervening split/reassignment.
+- ``lock-discipline``         — no attribute mutated from more than one
+  thread entry point without a common lock.
+- ``donation-consistency``    — no read of an argument after it was
+  donated to a jitted call (``donate_argnums``).
+- ``bare-counter``            — no ad-hoc ``self._*_counter`` attributes
+  outside ``monitor/`` (absorbed from scripts/lint_telemetry.py).
+- ``marker-audit``            — chaos-behavior tests carry the ``chaos``
+  marker; slow sleeps carry ``slow``; only registered markers are used.
+
+Rules are AST heuristics scoped to this codebase's idioms — module-local
+call graphs, bare-name hot registries — tuned so the shipped tree is
+clean and every seeded violation in tests/test_analysis.py is caught.
+They do not execute or import the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.annotations import HOT_PATH_REGISTRY
+from deeplearning4j_tpu.analysis.engine import (
+    Finding,
+    LintConfig,
+    Module,
+    Rule,
+)
+
+__all__ = ["ALL_RULES"]
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain ('' when not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_defs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def own_body_walk(fn: ast.AST):
+    """Walk ``fn``'s body WITHOUT descending into nested def/class bodies
+    (nested defs are separate call-graph nodes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def has_decorator(fn, *names: str) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        d = dotted(target)
+        if d and (d in names or d.split(".")[-1] in names):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# linear statement walker (order-aware rules: rng-reuse, donation)
+# ---------------------------------------------------------------------------
+
+
+_MATCH = getattr(ast, "Match", None)  # 3.10+
+
+
+class SeqWalker:
+    """Statement-order walk of one function body. If branches are
+    analyzed from a common snapshot and merged — a branch that
+    TERMINATES (return/raise/break/continue) does not pollute the
+    fall-through state, so mutually-exclusive ``if c: return use(key)``
+    chains are not double-counted. Loop bodies are processed TWICE so
+    state poisoned on iteration k is seen by reads on iteration k+1
+    (the cross-iteration reuse/donation hazard class). Expressions are
+    visited post-order (children first), matching evaluation order:
+    a call's arguments are read BEFORE the call's effects apply."""
+
+    def walk_function(self, fn) -> None:
+        self.walk_body(fn.body)
+
+    def walk_body(self, body: Sequence[ast.stmt]) -> bool:
+        """Returns True when the body terminates control flow."""
+        for stmt in body:
+            if self.walk_stmt(stmt):
+                return True
+        return False
+
+    def walk_stmt(self, stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.visit_expr(child)
+            return True
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.visit_expr(stmt.iter)
+            for _ in range(2):
+                self.on_bind_target(stmt.target)
+                if self.walk_body(stmt.body):
+                    break
+            self.walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.visit_expr(stmt.test)
+            for _ in range(2):
+                if self.walk_body(stmt.body):
+                    break
+            self.walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self.visit_expr(stmt.test)
+            snap = self.snapshot()
+            then_terminates = self.walk_body(stmt.body)
+            then_state = self.snapshot()
+            self.restore(snap)
+            else_terminates = self.walk_body(stmt.orelse)
+            if then_terminates and else_terminates:
+                return True
+            if else_terminates:
+                self.restore(then_state)  # fall-through = then only
+            elif not then_terminates:
+                self.merge(then_state)
+            # then_terminates alone: fall-through = else state (current)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.on_bind_target(item.optional_vars)
+            return self.walk_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            # body and handlers are mutually exclusive paths: handlers
+            # run from the PRE-try snapshot (like If branches), so a
+            # try/except consumer fallback is not double-counted
+            snap = self.snapshot()
+            self.walk_body(stmt.body)
+            body_state = self.snapshot()
+            handler_states = []
+            for handler in stmt.handlers:
+                self.restore(snap)
+                if not self.walk_body(handler.body):
+                    handler_states.append(self.snapshot())
+            self.restore(body_state)
+            self.walk_body(stmt.orelse)
+            for state in handler_states:
+                self.merge(state)
+            return self.walk_body(stmt.finalbody)
+        elif isinstance(stmt, ast.Assign):
+            self.visit_expr(stmt.value)
+            for t in stmt.targets:
+                self.on_bind_target(t, value=stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+                self.on_bind_target(stmt.target, value=stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self.visit_expr(stmt.value)
+            self.visit_expr(stmt.target)
+            self.on_bind_target(stmt.target, value=stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self.visit_expr(stmt.value)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            self.on_nested_def(stmt)
+        elif _MATCH is not None and isinstance(stmt, _MATCH):
+            # cases are mutually exclusive branches (like If); no case
+            # may match at all, so the pre-match state is the base and
+            # every non-terminating case merges into it
+            self.visit_expr(stmt.subject)
+            snap = self.snapshot()
+            case_states = []
+            for case in stmt.cases:
+                self.restore(snap)
+                if case.guard is not None:
+                    self.visit_expr(case.guard)
+                if not self.walk_body(case.body):
+                    case_states.append(self.snapshot())
+            self.restore(snap)
+            for state in case_states:
+                self.merge(state)
+        elif isinstance(stmt, (ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.visit_expr(child)
+        # pass/import/global/nonlocal: no expr state
+        return False
+
+    # -- hooks -----------------------------------------------------------
+
+    def visit_expr(self, expr: Optional[ast.expr]) -> None:
+        if expr is None:
+            return
+        self._visit_ordered(expr)
+
+    def _visit_ordered(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # separate scope, walked as its own function
+        for child in ast.iter_child_nodes(node):
+            self._visit_ordered(child)
+        self.on_node(node)
+
+    def on_node(self, node: ast.AST) -> None:
+        raise NotImplementedError
+
+    def on_bind_target(self, target: ast.expr, value=None) -> None:
+        raise NotImplementedError
+
+    def on_nested_def(self, stmt) -> None:
+        pass
+
+    def snapshot(self):
+        raise NotImplementedError
+
+    def restore(self, state) -> None:
+        raise NotImplementedError
+
+    def merge(self, other_state) -> None:
+        raise NotImplementedError
+
+
+def bound_names(target: ast.expr):
+    """(names, attr_dotteds) bound by an assignment target."""
+    names: List[str] = []
+    attrs: List[str] = []
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d:
+                attrs.append(d)
+    return names, attrs
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+SYNC_CALL_NAMES = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "onp.asarray",
+}
+SYNC_ATTR_CALLS = {"item", "block_until_ready", "tolist"}
+
+
+class HostSyncRule(Rule):
+    id = "host-sync-in-hot-path"
+    doc = ("host-synchronizing call (float()/.item()/np.asarray/"
+           "jax.device_get/block_until_ready/.tolist) reachable from a "
+           "@traced function or a HOT_PATH_REGISTRY root")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        defs = list(iter_defs(module.tree))
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in defs:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        # module-local call graph: scope -> bare callee names, plus
+        # containment edges (nested defs AND lambdas run inside their
+        # parent's trace — closure syntax must not change coverage)
+        scopes = defs + [n for n in ast.walk(module.tree)
+                         if isinstance(n, ast.Lambda)]
+        callees: Dict[ast.AST, Set[str]] = {}
+        children: Dict[ast.AST, List[ast.AST]] = {}
+        for fn in scopes:
+            names: Set[str] = set()
+            for node in own_body_walk(fn):
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    if d:
+                        names.add(d.split(".")[-1])
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.Lambda)):
+                    children.setdefault(fn, []).append(node)
+            callees[fn] = names
+
+        hot: Set[ast.AST] = set()
+        work = [fn for fn in defs
+                if fn.name in HOT_PATH_REGISTRY
+                or has_decorator(fn, "traced")]
+        while work:
+            fn = work.pop()
+            if fn in hot:
+                continue
+            hot.add(fn)
+            work.extend(children.get(fn, []))
+            for callee_name in callees.get(fn, ()):
+                for target in by_name.get(callee_name, ()):
+                    if target not in hot:
+                        work.append(target)
+
+        out: List[Finding] = []
+        for fn in hot:
+            for node in own_body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                d = dotted(node.func)
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "float"
+                        and not self._host_scalar_arg(node)):
+                    msg = ("float() forces a device->host sync on traced "
+                           "values")
+                elif d in SYNC_CALL_NAMES:
+                    msg = f"{d}() materializes device data on the host"
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in SYNC_ATTR_CALLS):
+                    msg = (f".{node.func.attr}() forces a device->host "
+                           "sync")
+                if msg:
+                    scope = getattr(fn, "name", "<lambda>")
+                    self.emit(out, module, node,
+                              f"{msg} inside hot path '{scope}' "
+                              "(reachable from a traced root)")
+        return out
+
+    @staticmethod
+    def _host_scalar_arg(call: ast.Call) -> bool:
+        """float(len(...)) / float(<literal>) convert host scalars, not
+        traced values — never a device sync."""
+        if len(call.args) != 1:
+            return False
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant):
+            return True
+        return (isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id == "len")
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+CACHE_ATTR_RE = re.compile(r"^_\w*(steps|cache|programs?|jits?)\w*$")
+UNHASHABLE_CTORS = {
+    "list", "dict", "set", "bytearray",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jnp.asarray", "jnp.array",
+}
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    doc = ("unhashable or object-typed value flowing into a jit "
+           "program-cache key (_epoch_steps and friends): every lookup "
+           "misses, every call recompiles")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in iter_defs(module.tree):
+            # Name -> [(lineno, value)] assignments within this fn; a use
+            # resolves to the LATEST assignment at or before its line, so
+            # `key = list(d); key = tuple(key)` is clean at a later use
+            # and `key = (a, b); key = list(key)` is caught
+            assigns: Dict[str, List[Tuple[int, ast.expr]]] = {}
+            for node in own_body_walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, []).append(
+                            (node.lineno, node.value))
+            for node in own_body_walk(fn):
+                key_expr = cache_name = None
+                if (isinstance(node, ast.Subscript)
+                        and isinstance(node.value, ast.Attribute)
+                        and CACHE_ATTR_RE.match(node.value.attr)):
+                    key_expr = node.slice
+                    cache_name = dotted(node.value)
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr in ("get", "setdefault", "pop")
+                      and isinstance(node.func.value, ast.Attribute)
+                      and CACHE_ATTR_RE.match(node.func.value.attr)
+                      and node.args):
+                    key_expr = node.args[0]
+                    cache_name = dotted(node.func.value)
+                if key_expr is None:
+                    continue
+                use_line = getattr(node, "lineno", 0)
+                for elt, why in self._bad_elements(key_expr, assigns,
+                                                   use_line):
+                    self.emit(out, module, elt,
+                              f"cache key for '{cache_name}' contains "
+                              f"{why} — unhashable or identity-keyed "
+                              "values defeat the program cache (one "
+                              "recompile per call)")
+        return out
+
+    @staticmethod
+    def _resolve(expr, assigns, use_line):
+        """Latest assignment to a Name at or before ``use_line``."""
+        if not isinstance(expr, ast.Name):
+            return expr
+        best = None
+        for lineno, value in assigns.get(expr.id, ()):
+            if lineno <= use_line and (best is None or lineno > best[0]):
+                best = (lineno, value)
+        return best[1] if best else expr
+
+    def _bad_elements(self, key_expr, assigns, use_line):
+        key_expr = self._resolve(key_expr, assigns, use_line)
+        elts = (key_expr.elts if isinstance(key_expr, ast.Tuple)
+                else [key_expr])
+        for elt in elts:
+            why = self._why_bad(self._resolve(elt, assigns, use_line))
+            if why:
+                yield elt, why
+
+    @staticmethod
+    def _why_bad(expr) -> Optional[str]:
+        if isinstance(expr, (ast.List, ast.ListComp)):
+            return "a list"
+        if isinstance(expr, (ast.Dict, ast.DictComp)):
+            return "a dict"
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return "a set"
+        if isinstance(expr, ast.GeneratorExp):
+            return "a generator (identity-hashed)"
+        if isinstance(expr, ast.Lambda):
+            return "a lambda (identity-hashed: a fresh object per build)"
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d in UNHASHABLE_CTORS or d.split(".")[-1] in (
+                    "asarray", "tolist"):
+                return f"a call to {d}() (unhashable result)"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# rng-reuse
+# ---------------------------------------------------------------------------
+
+KEY_NAME_RE = re.compile(
+    r"^_?(rng|rngs|e?key|.*_keys?|keys|subkeys?\d*)$")
+KEY_CONSUMER_FNS = {"epoch_schedule"}
+KEY_CREATORS = {"PRNGKey", "key"}
+
+
+class _RngWalker(SeqWalker):
+    def __init__(self, rule: Rule, module: Module, out: List[Finding]):
+        self.rule, self.module, self.out = rule, module, out
+        # tracked key name -> times consumed since last (re)binding
+        self.consumed: Dict[str, int] = {}
+        self.reported: Set[Tuple[int, int]] = set()
+
+    # state = copy of consumed map
+    def snapshot(self):
+        return dict(self.consumed)
+
+    def restore(self, state):
+        self.consumed = dict(state)
+
+    def merge(self, other):
+        for name, n in other.items():
+            self.consumed[name] = max(self.consumed.get(name, 0), n)
+
+    def track_param(self, name: str) -> None:
+        if KEY_NAME_RE.match(name):
+            self.consumed[name] = 0
+
+    def on_bind_target(self, target, value=None):
+        names, attrs = bound_names(target)
+        fresh = value is not None and self._is_key_source(value)
+        for name in names:
+            if fresh or KEY_NAME_RE.match(name) or name in self.consumed:
+                self.consumed[name] = 0
+        for attr in attrs:
+            if attr in self.consumed or KEY_NAME_RE.match(
+                    attr.split(".")[-1]):
+                self.consumed[attr] = 0
+
+    @staticmethod
+    def _is_key_source(value) -> bool:
+        for node in ast.walk(value):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d.startswith("jax.random.") or d in KEY_CONSUMER_FNS:
+                    return True
+        return False
+
+    def on_node(self, node):
+        if not isinstance(node, ast.Call):
+            return
+        d = dotted(node.func)
+        consumer = (d.startswith("jax.random.")
+                    and d.split(".")[-1] not in KEY_CREATORS)
+        consumer = consumer or d.split(".")[-1] in KEY_CONSUMER_FNS
+        if consumer and node.args:
+            arg = node.args[0]
+            key = (arg.id if isinstance(arg, ast.Name)
+                   else dotted(arg) if isinstance(arg, ast.Attribute)
+                   else None)
+            if key is None:
+                return
+            if not (key in self.consumed
+                    or KEY_NAME_RE.match(key.split(".")[-1])):
+                return
+            count = self.consumed.get(key, 0)
+            if count >= 1:
+                loc = (node.lineno, node.col_offset)
+                if loc not in self.reported:
+                    self.reported.add(loc)
+                    self.rule.emit(
+                        self.out, self.module, node,
+                        f"RNG key '{key}' consumed again by {d}() without "
+                        "an intervening split/reassignment — identical "
+                        "randomness flows to two consumers")
+            self.consumed[key] = count + 1
+
+
+class RngReuseRule(Rule):
+    id = "rng-reuse"
+    doc = ("a jax.random key used by two consumers without an "
+           "intervening split: both draw identical randomness")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in iter_defs(module.tree):
+            walker = _RngWalker(self, module, out)
+            for arg in (list(fn.args.posonlyargs) + list(fn.args.args)
+                        + list(fn.args.kwonlyargs)):
+                walker.track_param(arg.arg)
+            walker.walk_function(fn)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCKISH_RE = re.compile(r"lock|mutex|cond|_cv\b|\bcv\b|_mu\b", re.I)
+THREAD_LAUNCH_RE = re.compile(r"(^|\.)Thread$")
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    doc = ("attribute mutated from more than one thread entry point "
+           "(Thread target / executor submit / signal handler) without "
+           "a common lock")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(module, node, out)
+        return out
+
+    def _check_class(self, module: Module, cls: ast.ClassDef,
+                     out: List[Finding]) -> None:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        # --- thread entry points -------------------------------------
+        bg_seed_methods: Set[str] = set()
+        bg_closures: List[ast.AST] = []  # nested defs handed to Thread()
+        for m in methods.values():
+            nested = {d.name: d for d in ast.walk(m)
+                      if isinstance(d, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and d is not m}
+            for call in ast.walk(m):
+                if not isinstance(call, ast.Call):
+                    continue
+                d = dotted(call.func)
+                target = None
+                if THREAD_LAUNCH_RE.search(d or ""):
+                    for kw in call.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                elif (isinstance(call.func, ast.Attribute)
+                      and call.func.attr == "submit" and call.args):
+                    target = call.args[0]
+                elif d == "signal.signal" and len(call.args) >= 2:
+                    target = call.args[1]
+                if target is None:
+                    continue
+                td = dotted(target)
+                if td.startswith("self."):
+                    bg_seed_methods.add(td.split(".", 1)[1])
+                elif isinstance(target, ast.Name) and target.id in nested:
+                    bg_closures.append(nested[target.id])
+        if not bg_seed_methods and not bg_closures:
+            return
+        # --- transitive closure over self.X() calls ------------------
+        def self_callees(fn) -> Set[str]:
+            names = set()
+            for c in ast.walk(fn):
+                if isinstance(c, ast.Call):
+                    d = dotted(c.func)
+                    if d.startswith("self."):
+                        names.add(d.split(".", 1)[1].split(".")[0])
+            return names
+
+        bg_methods: Set[str] = set()
+        work = list(bg_seed_methods)
+        for closure in bg_closures:
+            work.extend(n for n in self_callees(closure))
+        while work:
+            name = work.pop()
+            if name in bg_methods or name not in methods:
+                continue
+            bg_methods.add(name)
+            work.extend(self_callees(methods[name]))
+
+        bg_contexts: List[Tuple[str, ast.AST]] = (
+            [(n, methods[n]) for n in sorted(bg_methods)]
+            + [(f"<closure {c.name}>", c) for c in bg_closures])
+        closure_nodes = set(bg_closures)
+        fg_contexts = [
+            (n, m) for n, m in methods.items()
+            if n not in bg_methods and n != "__init__"]
+
+        # --- write sites ---------------------------------------------
+        def writes(ctx_fn, skip_closures: bool):
+            sites = []
+            stack = list(ast.iter_child_nodes(ctx_fn))
+            nodes = []
+            while stack:
+                node = stack.pop()
+                if skip_closures and node in closure_nodes:
+                    continue  # that subtree runs on the bg thread and
+                    # is walked as its own bg context
+                nodes.append(node)
+                stack.extend(ast.iter_child_nodes(node))
+            for node in nodes:
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        for sub in ast.walk(t):
+                            if (isinstance(sub, ast.Attribute)
+                                    and isinstance(sub.value, ast.Name)
+                                    and sub.value.id == "self"):
+                                attr = sub.attr
+                                if LOCKISH_RE.search(attr):
+                                    continue
+                                sites.append(
+                                    (attr, node,
+                                     self._locked(module, ctx_fn, node)))
+            return sites
+
+        # closures nested in a fg method run on the bg thread: exclude
+        # them from the fg method's own write set
+        bg_writes: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        for name, ctx in bg_contexts:
+            for attr, node, locked in writes(ctx, skip_closures=False):
+                bg_writes.setdefault(attr, []).append((name, node, locked))
+        fg_writes: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        for name, ctx in fg_contexts:
+            for attr, node, locked in writes(ctx, skip_closures=True):
+                fg_writes.setdefault(attr, []).append((name, node, locked))
+
+        for attr, bsites in sorted(bg_writes.items()):
+            fsites = fg_writes.get(attr, [])
+            bg_names = {n for n, _, _ in bsites}
+            contexts = bg_names | {n for n, _, _ in fsites}
+            if len(contexts) < 2:
+                continue
+            unprotected = ([s for s in bsites if not s[2]]
+                           + [s for s in fsites if not s[2]])
+            # every unlocked site is its own finding: a suppression on
+            # one (e.g. the signal-handler latch) must not silence an
+            # unrelated unlocked write of the same attribute elsewhere
+            for name, node, _ in unprotected:
+                others = sorted(contexts - {name}) or sorted(contexts)
+                self.emit(
+                    out, module, node,
+                    f"'{cls.name}.{attr}' is mutated from thread context "
+                    f"'{name}' and also from {', '.join(others)} with at "
+                    "least one unlocked write — wrap the writes in a "
+                    "common lock or confine the attribute to one thread")
+
+    @staticmethod
+    def _locked(module: Module, ctx_fn, node) -> bool:
+        """Is ``node`` under a ``with <something lock-ish>:`` within the
+        context function?"""
+        cur = module.parents.get(node)
+        while cur is not None and cur is not ctx_fn:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    try:
+                        text = ast.unparse(item.context_expr)
+                    except Exception:  # pragma: no cover - unparse safety
+                        text = dotted(item.context_expr)
+                    if LOCKISH_RE.search(text):
+                        return True
+            cur = module.parents.get(cur)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# donation-consistency
+# ---------------------------------------------------------------------------
+
+# methods/properties known to wrap a donating jax.jit. Matched by BARE
+# name, so each entry lists the INTERSECTION of positions donated by
+# every same-named implementation in the tree — `_train_step` donates
+# (0, 1, 2) on MLN/CG but only (0, 1) on RNTN and the replicated
+# data-parallel step, so position 2 is NOT listed (a name-keyed (0,1,2)
+# would false-positive on correct RNTN code). `_fsdp_train_step`
+# donates conditionally ((0, 1, 2) if self._donate else ()) and is
+# deliberately absent: an unknown spec must not poison legal reads.
+KNOWN_DONATING_ATTRS: Dict[str, Tuple[int, ...]] = {
+    "_train_step": (0, 1),
+    "_multi_train_step": (0, 1, 2),
+    "_tbptt_train_step": (0, 1, 2),
+}
+# factories RETURNING a donating program: fn = self._epoch_train_step(...)
+KNOWN_DONATING_FACTORIES: Dict[str, Tuple[int, ...]] = {
+    "_epoch_train_step": (0, 1, 2),
+    "_epoch_program": (0, 1, 2),
+}
+
+
+def _donate_positions(expr) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums positions, or None when indeterminate.
+    ``(0, 1) if donate else ()`` and ``range(n)`` are NOT treated as
+    always-donating — an unknown spec must not poison legal reads."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return (expr.value,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(sorted(set(out))) or None
+    return None
+
+
+def _decorated_donations(module: Module) -> Dict[str, Tuple[int, ...]]:
+    """Function name -> donate positions for defs decorated with the
+    ``@functools.partial(jax.jit, donate_argnums=...)`` idiom."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for fn in iter_defs(module.tree):
+        for dec in fn.decorator_list:
+            if not (isinstance(dec, ast.Call) and dec.args):
+                continue
+            if dotted(dec.func).split(".")[-1] != "partial":
+                continue
+            if dotted(dec.args[0]) not in ("jax.jit", "jit"):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    pos = _donate_positions(kw.value)
+                    if pos:
+                        out[fn.name] = pos
+    return out
+
+
+class _DonationWalker(SeqWalker):
+    def __init__(self, rule: Rule, module: Module, out: List[Finding],
+                 donating_names: Optional[Dict[str, Tuple[int, ...]]]
+                 = None):
+        self.rule, self.module, self.out = rule, module, out
+        self.donating_names = donating_names or {}
+        self.jit_vars: Dict[str, Tuple[int, ...]] = {}
+        # donated value name/attr -> line it was donated on
+        self.poisoned: Dict[str, int] = {}
+        self.reported: Set[Tuple[int, int]] = set()
+
+    def snapshot(self):
+        return (dict(self.jit_vars), dict(self.poisoned))
+
+    def restore(self, state):
+        self.jit_vars, self.poisoned = dict(state[0]), dict(state[1])
+
+    def merge(self, other):
+        self.jit_vars.update(other[0])
+        for k, v in other[1].items():
+            self.poisoned.setdefault(k, v)
+
+    def on_bind_target(self, target, value=None):
+        if value is not None and isinstance(target, ast.Name):
+            donated = self._donating_value(value)
+            if donated is not None:
+                self.jit_vars[target.id] = donated
+        names, attrs = bound_names(target)
+        for ref in names + attrs:
+            self.poisoned.pop(ref, None)
+
+    @staticmethod
+    def _donating_value(value) -> Optional[Tuple[int, ...]]:
+        """donate positions when ``value`` is jax.jit(..., donate_argnums=)
+        or a call to a known donating factory."""
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted(value.func)
+        if d.split(".")[-1] in KNOWN_DONATING_FACTORIES and d.startswith(
+                ("self.", "net.", "network.")):
+            return KNOWN_DONATING_FACTORIES[d.split(".")[-1]]
+        if d not in ("jax.jit", "jit"):
+            return None
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                return _donate_positions(kw.value)
+        return None
+
+    def on_node(self, node):
+        # post-order: a call's argument reads are checked BEFORE the
+        # call's own donation poisons them
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self._check_read(node.id, node)
+        elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            d = dotted(node)
+            if d in self.poisoned:
+                self._check_read(d, node)
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+
+    def _check_read(self, ref: str, node) -> None:
+        if ref not in self.poisoned:
+            return
+        loc = (node.lineno, node.col_offset)
+        if loc in self.reported:
+            return
+        self.reported.add(loc)
+        self.rule.emit(
+            self.out, self.module, node,
+            f"'{ref}' was donated to a jitted call on line "
+            f"{self.poisoned[ref]} (donate_argnums) and is read "
+            "afterwards — its buffer may already be aliased/overwritten")
+
+    def _visit_call(self, call: ast.Call) -> None:
+        positions = None
+        if isinstance(call.func, ast.Name):
+            positions = (self.jit_vars.get(call.func.id)
+                         or self.donating_names.get(call.func.id))
+        else:
+            d = dotted(call.func)
+            if (d.startswith(("self.", "net.", "network."))
+                    and d.split(".")[-1] in KNOWN_DONATING_ATTRS):
+                positions = KNOWN_DONATING_ATTRS[d.split(".")[-1]]
+        if not positions:
+            return
+        for p in positions:
+            if p >= len(call.args):
+                continue
+            arg = call.args[p]
+            ref = (arg.id if isinstance(arg, ast.Name)
+                   else dotted(arg) if isinstance(arg, ast.Attribute)
+                   else None)
+            if ref:
+                self.poisoned.setdefault(ref, call.lineno)
+
+
+class DonationConsistencyRule(Rule):
+    id = "donation-consistency"
+    doc = ("an argument listed in donate_argnums is referenced after "
+           "the jitted call: the donated buffer may be aliased or "
+           "already overwritten")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        out: List[Finding] = []
+        donating = _decorated_donations(module)
+        for fn in iter_defs(module.tree):
+            _DonationWalker(self, module, out,
+                            donating_names=donating).walk_function(fn)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# bare-counter (absorbed from scripts/lint_telemetry.py)
+# ---------------------------------------------------------------------------
+
+BARE_COUNTER_RE = re.compile(r"^_\w*_counter$")
+
+
+class BareCounterRule(Rule):
+    id = "bare-counter"
+    doc = ("new bare self._*_counter attribute outside monitor/ — use "
+           "monitor.record_counter()/metrics() so the value reaches the "
+           "exporters")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        if module.rel.startswith("deeplearning4j_tpu/monitor/"):
+            return []
+        if not module.rel.startswith("deeplearning4j_tpu/"):
+            return []  # tests/fixtures may assign counters freely
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for sub in ast.walk(t):
+                    if (isinstance(sub, ast.Attribute)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"
+                            and BARE_COUNTER_RE.match(sub.attr)):
+                        self.emit(
+                            out, module, node,
+                            f"bare counter attribute 'self.{sub.attr}' "
+                            "outside monitor/ — route it through "
+                            "monitor.record_counter()/metrics() instead")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# marker-audit
+# ---------------------------------------------------------------------------
+
+PYTEST_BUILTIN_MARKS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "timeout", "flaky", "no_cover",
+}
+SLOW_SLEEP_S = 1.0
+
+
+class MarkerAuditRule(Rule):
+    id = "marker-audit"
+    doc = ("pytest-marker audit: chaos-behavior tests must carry the "
+           "registered 'chaos' marker, >=1s sleeps need 'slow'/'chaos', "
+           "and only markers registered in pyproject.toml may be used")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        parts = module.rel.split("/")
+        if "tests" not in parts or not parts[-1].startswith("test_"):
+            return []
+        registered = config.markers() | PYTEST_BUILTIN_MARKS
+        out: List[Finding] = []
+        module_marks = self._module_marks(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute):
+                base = dotted(node.value)
+                if base == "pytest.mark" and node.attr not in registered:
+                    self.emit(
+                        out, module, node,
+                        f"marker '{node.attr}' is not registered in "
+                        "pyproject.toml [tool.pytest.ini_options] "
+                        "markers (typo, or register it)")
+        for fn in iter_defs(module.tree):
+            if not fn.name.startswith("test_"):
+                continue
+            marks = (module_marks | self._own_marks(fn)
+                     | self._class_marks(module, fn))
+            if self._drives_chaos(fn) and "chaos" not in marks:
+                self.emit(
+                    out, module, fn,
+                    f"test '{fn.name}' drives fault injection "
+                    "(DL4J_FAULTS/faults.install/fault_point) but lacks "
+                    "@pytest.mark.chaos — chaos selection (-m chaos) "
+                    "will miss it")
+            if not marks & {"slow", "chaos"}:
+                for call in ast.walk(fn):
+                    if (isinstance(call, ast.Call)
+                            and dotted(call.func) in ("time.sleep",
+                                                      "sleep")
+                            and call.args
+                            and isinstance(call.args[0], ast.Constant)
+                            and isinstance(call.args[0].value,
+                                           (int, float))
+                            and call.args[0].value >= SLOW_SLEEP_S):
+                        self.emit(
+                            out, module, call,
+                            f"test '{fn.name}' sleeps "
+                            f"{call.args[0].value}s without "
+                            "@pytest.mark.slow — tier-1 pays that wall "
+                            "clock on every run")
+        return out
+
+    @staticmethod
+    def _drives_chaos(fn) -> bool:
+        """True when the test CODE drives fault injection — calls to
+        fault_point/install_from_env/faults.install/FaultSpec or a
+        DL4J_FAULTS string constant. AST-based so a docstring or comment
+        that merely MENTIONS these names never demands a chaos marker."""
+        doc = None
+        if (fn.body and isinstance(fn.body[0], ast.Expr)
+                and isinstance(fn.body[0].value, ast.Constant)
+                and isinstance(fn.body[0].value.value, str)):
+            doc = fn.body[0].value
+        for node in ast.walk(fn):
+            if node is doc:
+                continue
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if (d.split(".")[-1] in ("fault_point",
+                                         "install_from_env",
+                                         "FaultSpec")
+                        or d == "faults.install"
+                        or d.endswith(".faults.install")):
+                    return True
+            elif isinstance(node, ast.Name) and node.id == "FaultSpec":
+                return True
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, str)
+                  and "DL4J_FAULTS" in node.value):
+                return True
+        return False
+
+    @staticmethod
+    def _marks_from_decorators(decorators) -> Set[str]:
+        marks = set()
+        for dec in decorators:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = dotted(target)
+            if d.startswith("pytest.mark."):
+                marks.add(d.split(".")[2])
+        return marks
+
+    def _own_marks(self, fn) -> Set[str]:
+        return self._marks_from_decorators(fn.decorator_list)
+
+    def _class_marks(self, module: Module, fn) -> Set[str]:
+        marks: Set[str] = set()
+        for scope in module.enclosing_scopes(fn):
+            if isinstance(scope, ast.ClassDef):
+                marks |= self._marks_from_decorators(scope.decorator_list)
+        return marks
+
+    def _module_marks(self, module: Module) -> Set[str]:
+        marks: Set[str] = set()
+        for node in module.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "pytestmark"
+                            for t in node.targets)):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Attribute):
+                        d = dotted(sub)
+                        if d.startswith("pytest.mark."):
+                            marks.add(d.split(".")[2])
+        return marks
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    HostSyncRule(),
+    RecompileHazardRule(),
+    RngReuseRule(),
+    LockDisciplineRule(),
+    DonationConsistencyRule(),
+    BareCounterRule(),
+    MarkerAuditRule(),
+)

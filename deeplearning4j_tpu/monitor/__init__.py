@@ -115,5 +115,5 @@ def fused_metrics_stride(override=None) -> int:
 def record_counter(name: str, amount: float = 1.0, **labels) -> None:
     """One-line counter bump against the global registry — the idiom the
     control plane uses instead of growing new bare ``_*_counter``
-    attributes (``scripts/lint_telemetry.py`` enforces it)."""
+    attributes (dl4j-lint's ``bare-counter`` rule enforces it)."""
     metrics().counter(name).inc(amount, **labels)

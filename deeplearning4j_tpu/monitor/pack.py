@@ -30,6 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from deeplearning4j_tpu.analysis.annotations import traced
 
 __all__ = ["METRIC_NAMES", "N_METRICS", "step_metrics",
            "tree_global_norm"]
@@ -39,6 +40,7 @@ METRIC_NAMES = ("grad_norm", "update_norm", "param_norm", "lr_scale")
 N_METRICS = len(METRIC_NAMES)
 
 
+@traced
 def tree_global_norm(tree):
     """Traced f32 global L2 norm over every floating leaf of ``tree``
     (integer leaves — updater step counters — are skipped). Accumulates
@@ -52,6 +54,7 @@ def tree_global_norm(tree):
     return jnp.sqrt(functools.reduce(jnp.add, sq))
 
 
+@traced
 def step_metrics(params, new_params, grads, lr_scale, iteration,
                  stride: int):
     """The ``[4]`` f32 metrics vector for one fused optimizer step.

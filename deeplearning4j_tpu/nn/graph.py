@@ -64,6 +64,7 @@ from deeplearning4j_tpu.perf.epoch_cache import (
     epoch_schedule,
     stream_epochs,
 )
+from deeplearning4j_tpu.analysis.annotations import traced
 
 
 def _slice_mds_time(mds: MultiDataSet, start: int, end: int) -> MultiDataSet:
@@ -320,6 +321,7 @@ class ComputationGraph:
             new_updater[name] = upd_i
         return new_params, new_updater
 
+    @traced
     def _loss_grads(self, params, net_state, inputs, labels,
                     feature_masks, label_masks, rng, rnn_state=None):
         """Training loss + gradients (pure; caller wraps the dtype policy
@@ -332,6 +334,7 @@ class ComputationGraph:
 
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
+    @traced
     def _step_impl(self, params, updater_state, net_state, iteration,
                    inputs, labels, feature_masks, label_masks, rng,
                    rnn_state):
@@ -345,6 +348,7 @@ class ComputationGraph:
                 params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, new_rnn
 
+    @traced
     def _accum_loss_grads(self, params, net_state, inputs, labels,
                           feature_masks, label_masks, rng,
                           accum_steps: int):
@@ -404,6 +408,7 @@ class ComputationGraph:
             body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
         return grads, loss, new_net_state
 
+    @traced
     def _accum_step_impl(self, params, updater_state, net_state, iteration,
                          inputs, labels, feature_masks, label_masks, rng,
                          accum_steps: int):
@@ -422,6 +427,7 @@ class ComputationGraph:
                 params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, None
 
+    @traced
     def _guarded_step_impl(self, params, updater_state, net_state,
                            iteration, lr_scale_host, inputs, labels,
                            feature_masks, label_masks, rng,
@@ -457,6 +463,7 @@ class ComputationGraph:
                 ok, apply, skip, None)
         return new_params, new_updater, new_nst, loss, ~ok
 
+    @traced
     def _telemetry_step_impl(self, params, updater_state, net_state,
                              iteration, lr_scale_host, inputs, labels,
                              feature_masks, label_masks, rng,
@@ -583,6 +590,7 @@ class ComputationGraph:
     # whole-epoch fusion (the ComputationGraph counterpart of
     # MultiLayerNetwork.fit_epochs — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
+    @traced
     def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1,
                       guard: bool = False, metrics_stride: int = 0):
         """The PURE chunk program: E epochs x N batches scanned over the

@@ -59,6 +59,7 @@ from deeplearning4j_tpu.perf.device_eval import (
     init_regression_sums,
     regression_update,
 )
+from deeplearning4j_tpu.analysis.annotations import traced
 from deeplearning4j_tpu.monitor import fused_metrics_stride, record_counter
 
 _RECURRENT_CONFS = (L.GravesLSTM, L.GravesBidirectionalLSTM, L.GRU, L.LSTM)
@@ -234,6 +235,7 @@ class MultiLayerNetwork:
             new_updater[si] = upd_i
         return new_params, new_updater
 
+    @traced
     def _loss_grads(self, params, net_state, x, y, feature_mask,
                     label_mask, rng, rnn_state=None):
         """Training loss + gradients (pure; caller wraps the dtype
@@ -248,6 +250,7 @@ class MultiLayerNetwork:
 
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
+    @traced
     def _step_impl(self, params, updater_state, net_state, iteration,
                    lr_scale_host, x, y, feature_mask, label_mask, rng,
                    rnn_state):
@@ -259,6 +262,7 @@ class MultiLayerNetwork:
                 params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, new_rnn, loss
 
+    @traced
     def _accum_loss_grads(self, params, net_state, x, y, feature_mask,
                           label_mask, rng, accum_steps: int):
         """Accumulated-microbatch loss + summed gradients (pure; caller
@@ -311,6 +315,7 @@ class MultiLayerNetwork:
             body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
         return grads, loss, new_net_state
 
+    @traced
     def _accum_step_impl(self, params, updater_state, net_state, iteration,
                          lr_scale_host, x, y, feature_mask, label_mask,
                          rng, accum_steps: int):
@@ -332,6 +337,7 @@ class MultiLayerNetwork:
                 params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, None, loss
 
+    @traced
     def _guarded_step_impl(self, params, updater_state, net_state,
                            iteration, lr_scale_host, x, y, feature_mask,
                            label_mask, rng, accum_steps: int):
@@ -372,6 +378,7 @@ class MultiLayerNetwork:
                 ok, apply, skip, None)
         return new_params, new_updater, new_nst, loss, ~ok
 
+    @traced
     def _telemetry_step_impl(self, params, updater_state, net_state,
                              iteration, lr_scale_host, x, y, feature_mask,
                              label_mask, rng, accum_steps: int,
@@ -559,6 +566,7 @@ class MultiLayerNetwork:
     # HBM-resident dataset cache (the epoch-level generalization of
     # fit_steps' single-batch fusion — see perf/epoch_cache.py)
     # ------------------------------------------------------------------
+    @traced
     def _epoch_run_fn(self, shuffle: bool, accum_steps: int = 1,
                       guard: bool = False, metrics_stride: int = 0):
         """The PURE chunk program: chunk_epochs x n_batches optimizer steps

@@ -16,8 +16,10 @@ from typing import Dict, Optional
 
 import numpy as np
 import jax.numpy as jnp
+from deeplearning4j_tpu.analysis.annotations import traced
 
 
+@traced
 def _flatten_time(output, labels, mask):
     """[b, t, c] -> [b*t, c] (mask [b, t] -> [b*t]), matching the host
     ``Evaluation.eval`` time-into-batch flattening."""
@@ -30,6 +32,7 @@ def _flatten_time(output, labels, mask):
     return output, labels, mask
 
 
+@traced
 def confusion_update(cm, output, labels, mask=None):
     """One batch folded into the device confusion matrix.
 
@@ -68,6 +71,7 @@ def init_regression_sums(num_columns: int) -> Dict[str, jnp.ndarray]:
             "c_yp": z(), "sum_abs": z(), "sum_sq": z()}
 
 
+@traced
 def regression_update(sums, output, labels, mask=None):
     output, labels, mask = _flatten_time(output, labels, mask)
     y = labels.astype(jnp.float32)

@@ -58,6 +58,7 @@ import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
+from deeplearning4j_tpu.analysis.annotations import traced
 
 from deeplearning4j_tpu.perf.bucketing import bucket_size, pad_axis0
 
@@ -167,6 +168,7 @@ def _place(arr, mesh, sharded: bool = True):
     return jax.device_put(arr, _batch_sharding(mesh, arr.ndim))
 
 
+@traced
 def epoch_schedule(epoch_key, n_batches: int, shuffle: bool):
     """(batch order, per-batch step keys) for one epoch, derived from one
     epoch key. Pure function of the key — the SAME derivation runs traced

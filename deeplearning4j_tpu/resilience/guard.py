@@ -35,6 +35,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+from deeplearning4j_tpu.analysis.annotations import traced
 
 logger = logging.getLogger(__name__)
 
@@ -92,6 +93,7 @@ def nan_guard_policy() -> str:
     return raw
 
 
+@traced
 def tree_all_finite(tree):
     """Traced scalar bool: every leaf of ``tree`` is everywhere finite.
     Integer leaves (updater step counters) are vacuously finite and

@@ -104,7 +104,11 @@ class PreemptionGuard:
         # interrupted frame may already hold — a latch must never
         # deadlock the preemption it reports. The next requested()/
         # check() poll (every chunk boundary) flushes it.
-        self._pending_latch = ("signal", {"signum": signum})
+        # CPython delivers signal handlers on the main thread only, so
+        # _pending_latch is main-thread-confined; a lock here could
+        # deadlock the very frame the handler interrupted.
+        self._pending_latch = (  # dl4j-lint: disable=lock-discipline -- signal handlers run on the main thread: no concurrent writer exists
+            "signal", {"signum": signum})
         prev = self._prev.get(signum)
         if callable(prev):
             prev(signum, frame)
@@ -112,7 +116,7 @@ class PreemptionGuard:
     def _flush_pending_latch(self) -> None:
         pending = self._pending_latch
         if pending is not None:
-            self._pending_latch = None
+            self._pending_latch = None  # dl4j-lint: disable=lock-discipline -- main-thread-confined: the only other writer is the signal handler, which CPython delivers on this same thread
             _latch_telemetry(pending[0], **pending[1])
 
     def request(self) -> None:

@@ -23,10 +23,16 @@
 #        scripts/verify.sh --obs      (just the telemetry suite — metrics
 #                                      pack parity/values, registry,
 #                                      tracer, exporters — plus the
-#                                      no-bare-counters lint)
-# The eval/epoch/dp/heal/obs tests are part of the default tier-1 run;
-# --eval/--epoch/--dp/--heal/--obs are the narrow fast paths for
-# iterating on those surfaces.
+#                                      no-bare-counters lint rule)
+#        scripts/verify.sh --lint     (static analysis gate: the full
+#                                      dl4j-lint ruleset over the tree +
+#                                      the program-contract checks and
+#                                      rule-engine fixtures in
+#                                      tests/test_analysis.py; nonzero
+#                                      exit on any NEW finding)
+# The eval/epoch/dp/heal/obs/lint tests are part of the default tier-1
+# run; --eval/--epoch/--dp/--heal/--obs/--lint are the narrow fast paths
+# for iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -49,7 +55,15 @@ elif [ "${1:-}" = "--obs" ]; then
     TARGET=tests/test_telemetry.py
     # the counters lint rides along with the telemetry suite: no module
     # besides monitor/ may define new bare _*_counter attributes
-    python scripts/lint_telemetry.py || exit 1
+    # (the old scripts/lint_telemetry.py, absorbed into dl4j-lint)
+    python scripts/dl4j_lint.py --select bare-counter || exit 1
+elif [ "${1:-}" = "--lint" ]; then
+    shift
+    # static-analysis gate: source-level ruleset first (stdlib-only,
+    # fails fast), then the jaxpr/HLO program-contract checks + the
+    # seeded-violation fixtures that keep the rules themselves honest
+    python scripts/dl4j_lint.py || exit 1
+    TARGET=tests/test_analysis.py
 fi
 
 rm -f /tmp/_t1.log

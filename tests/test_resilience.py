@@ -183,6 +183,7 @@ def _clean_faults():
     faults.clear()
 
 
+@pytest.mark.chaos
 class TestFaultPoints:
     def test_inactive_is_noop(self):
         fault_point("nothing.installed")  # no error, no state

@@ -40,10 +40,19 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
     block ("telemetry" key) with the grant-acquisition timeline
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
-Model FLOPs are analytic (formula noted per entry in "flops_source").
-Training data is synthetic (zero-egress sandbox; throughput does not
-depend on pixel/token values) via the same public ``fit`` APIs a user
-calls.
+Model FLOPs come from the COMPILED program's ``cost_analysis()`` when the
+backend provides one (monitor/profile.py), with the analytic formulas
+kept as a cross-check: each entry's "flops_source" block carries both
+counts and a ``flops_divergence_pct`` field, flagged above 10%. Each
+profiled entry also gets a "cost_model" step-time decomposition (optimal
+compute vs memory time from the roofline floors vs the measured step —
+compute-/memory-bound classification + dispatch wait), and every
+artifact — partials and error lines included — embeds the ProgramProfile
+blocks collected so far under extras["profile"] plus chunk-boundary HBM
+watermarks validating the epoch-cache budget model (the epoch section's
+"hbm_budget_check"). Training data is synthetic (zero-egress sandbox;
+throughput does not depend on pixel/token values) via the same public
+``fit`` APIs a user calls.
 """
 
 from __future__ import annotations
@@ -65,10 +74,71 @@ from deeplearning4j_tpu.monitor import (
 )
 
 PEAK_TFLOPS_BF16 = 197.0  # TPU v5e per-chip peak, bf16 MXU
+PEAK_HBM_GBPS = 819.0  # TPU v5e per-chip HBM bandwidth (roofline floor)
 
 
 def _log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def _profile_step(fn, args, name):
+    """Cost/memory profile of one jitted program (``fn.lower(*args)``
+    reads avals only — donated buffers are NOT consumed). The
+    cost-analysis FLOPs are the measured-FLOPs source for MFU; the
+    analytic formulas stay as the cross-check, with divergence >10%
+    flagged in the artifact. Costs one extra XLA compile per profiled
+    program; returns None (and logs) when the backend cannot analyze.
+    An explicit DL4J_PROFILE=0 opt-out (main() only sets the default)
+    skips the capture entirely — no extra compiles, no profile block
+    entries."""
+    from deeplearning4j_tpu.monitor.profile import (
+        capture_program_profile, profile_enabled)
+
+    if not profile_enabled():
+        return None
+    try:
+        prof, _ = capture_program_profile(fn, args, name=name,
+                                          key=("bench", name))
+    except Exception as e:
+        _log(f"profile capture for {name} failed: {e!r}")
+        return None
+    return prof
+
+
+def _flops_entry(analytic_flops, analytic_note, prof, per: int):
+    """The artifact's dual flops_source block: the analytic formula and
+    the compiled cost-analysis count, per sample (or token), plus their
+    divergence. ``per`` normalizes the whole-program cost-analysis count
+    (one step over ``per`` samples/tokens)."""
+    from deeplearning4j_tpu.monitor.profile import flops_divergence_pct
+
+    cost = (None if prof is None or prof.flops is None
+            else prof.flops / per)
+    div = flops_divergence_pct(analytic_flops, cost)
+    return {
+        "analytic": analytic_note,
+        "analytic_flops": round(float(analytic_flops), 1),
+        "cost_analysis_flops": None if cost is None else round(cost, 1),
+        "flops_divergence_pct": div,
+        "flops_divergence_flag": (div is not None and abs(div) > 10.0),
+    }
+
+
+def _cost_model_entry(prof, measured_s):
+    """Step-time decomposition against the compiled cost model: optimal
+    device time from the roofline floors vs the measured step —
+    classifies the section compute- vs memory-bound and prices the
+    dispatch wait."""
+    from deeplearning4j_tpu.monitor.profile import classify_boundedness
+
+    if prof is None:
+        return None
+    entry = classify_boundedness(
+        prof.flops, prof.bytes_accessed, measured_s,
+        PEAK_TFLOPS_BF16 * 1e12, PEAK_HBM_GBPS * 1e9)
+    entry["peak_hbm_bytes"] = prof.peak_bytes
+    entry["compile_s"] = prof.compile_s
+    return entry
 
 
 def _sync(x):
@@ -286,6 +356,8 @@ def bench_word2vec():
 
 
 def bench_resnet18():
+    import jax.numpy as jnp
+
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models import resnet18
 
@@ -297,18 +369,45 @@ def bench_resnet18():
     net = resnet18(num_classes=10, dtype_policy="bf16").init()
     ds = DataSet(x, y)
     fwd_flops = 1.11e9  # analytic CIFAR ResNet-18 fwd GFLOP/sample
+    # the x3 assumes backward ≈ 2x forward (dL/dW + dL/dx) and ignores
+    # the updater math — stated here because the compiled cost analysis
+    # below counts the REAL program and the divergence field quantifies
+    # exactly how much that assumption is off
+    analytic_note = ("analytic 1.11 GFLOP fwd/sample x3 "
+                     "(assumes bwd = 2x fwd; updater math excluded)")
+    prof = _profile_step(
+        net._train_step,
+        (net.params, net.updater_state, net.net_state,
+         jnp.asarray(0, jnp.int32), jnp.asarray(1.0, jnp.float32),
+         x, y, None, None, net._rng, None),
+        "resnet18_train_step")
     stepwise, fused = _fit_throughput(net, ds, batch, steps=10)
     sps = max(stepwise, fused)
-    tflops = 3 * fwd_flops * sps / 1e12
+    flops = _flops_entry(3 * fwd_flops, analytic_note, prof, batch)
+    per_sample = (flops["analytic_flops"]
+                  if flops["cost_analysis_flops"] is None
+                  else flops["cost_analysis_flops"])
+    tflops = per_sample * sps / 1e12
+    tflops_analytic = 3 * fwd_flops * sps / 1e12
     _log(f"resnet18: {sps:,.0f} samples/sec ({stepwise:,.0f} per-step, "
          f"{fused:,.0f} fused), {tflops:.1f} TFLOP/s "
-         f"({100 * tflops / PEAK_TFLOPS_BF16:.1f}% MFU)")
+         f"({100 * tflops / PEAK_TFLOPS_BF16:.1f}% MFU, "
+         f"flops divergence {flops['flops_divergence_pct']}%)")
     return {"samples_per_sec": round(sps, 1),
             "per_step": round(stepwise, 1), "fused": round(fused, 1),
             "batch": batch,
             "model_tflops": round(tflops, 1),
             "mfu_pct": round(100 * tflops / PEAK_TFLOPS_BF16, 1),
-            "flops_source": "analytic 1.11 GFLOP fwd/sample x3"}
+            "model_tflops_analytic": round(tflops_analytic, 1),
+            "mfu_pct_analytic": round(
+                100 * tflops_analytic / PEAK_TFLOPS_BF16, 1),
+            "flops_source": flops,
+            # the profile is of the SINGLE-step program, so the
+            # decomposition pairs it with the per-step measured time —
+            # not the fused path's (a different program with different
+            # dispatch amortization and HBM traffic)
+            "cost_model": _cost_model_entry(
+                prof, None if stepwise <= 0 else batch / stepwise)}
 
 
 def bench_infeed():
@@ -369,10 +468,20 @@ def bench_epoch():
                      rng.integers(0, 10, batch * n_batches)])
     total = batch * n_batches
 
+    budget_check = {}
+
     def run_cached(chunk):
         net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
         cache = DeviceDataSetCache.build(ListDataSetIterator(ds, batch))
         assert cache is not None, "bench dataset exceeded DL4J_DEVICE_CACHE_MB"
+        if not budget_check:
+            # runtime check of the per-shard HBM budget model: the
+            # analytic resident bytes the build priced vs what the
+            # device actually holds for these stacks
+            from deeplearning4j_tpu.monitor.memory import (
+                validate_cache_budget)
+
+            budget_check.update(validate_cache_budget(cache))
         # warm the SAME chunk length as the timed run: the fused program
         # is keyed on the epoch_keys shape [k, 2], so a chunk=1 warm-up
         # would leave the k=epochs program to compile inside the timing
@@ -414,7 +523,8 @@ def bench_epoch():
             "dispatches_per_epoch_fully_fused": round(fused_dpe, 2),
             "dispatches_per_epoch_streaming": round(stream_dpe, 2),
             "batch": batch, "n_batches": n_batches, "epochs": epochs,
-            "total_samples": total}
+            "total_samples": total,
+            "hbm_budget_check": budget_check or None}
 
 
 def bench_dp_epoch():
@@ -717,6 +827,9 @@ def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
         np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
     _sync(tokens)
     step = lm.make_train_step()
+    prof = _profile_step(
+        step, (lm.params, lm.opt_state, tokens, jnp.asarray(0, jnp.int32)),
+        f"transformer_b{batch}_t{t}_{attn}")
     sec_step = _time_loop(lambda: lm.fit_batch(tokens, train_step=step, block=False),
                           steps=steps, sync=lambda: lm.params)
     try:
@@ -732,7 +845,13 @@ def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
     sec = min(sec_step, sec_fused)
     tps = batch * t / sec
     fpt = _transformer_flops_per_token(lm, t)
-    tflops = fpt * tps / 1e12
+    flops = _flops_entry(
+        fpt, "analytic 6*N/token + attention term", prof, batch * t)
+    per_token = (flops["analytic_flops"]
+                 if flops["cost_analysis_flops"] is None
+                 else flops["cost_analysis_flops"])
+    tflops = per_token * tps / 1e12
+    tflops_analytic = fpt * tps / 1e12
     mfu = 100 * tflops / PEAK_TFLOPS_BF16
     return {
         "tokens_per_sec": round(tps, 1),
@@ -743,6 +862,11 @@ def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
         "batch": batch, "seq_len": t, "remat": remat,
         "attn_impl": lm._attn_impl(t),
         "model_tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
+        "model_tflops_analytic": round(tflops_analytic, 1),
+        "mfu_pct_analytic": round(
+            100 * tflops_analytic / PEAK_TFLOPS_BF16, 1),
+        "flops_source": flops,
+        "cost_model": _cost_model_entry(prof, sec_step),
     }, tps, lm
 
 
@@ -852,7 +976,11 @@ def bench_transformer(cpu_baseline=True, on_progress=None):
         result["headline_basis"] = (
             "forced attn_impl=flash beat the auto path at t=1024 — "
             "auto-crossover candidate")
-    result["flops_source"] = "analytic 6*N/token + attention term"
+    # best_cfg already carries the dual analytic/cost-analysis
+    # flops_source block; only fill the legacy string when the whole
+    # sweep errored out and there is no per-config block to keep
+    result.setdefault("flops_source",
+                      "analytic 6*N/token + attention term")
     result["config"] = "d512 L8 H8 v8192 bf16"
     result["batch_sweep_t1024"] = sweep
     result["long_context_t4096"] = flash_cfg
@@ -972,14 +1100,23 @@ def _await_backend(timeout_s: float = None):
 
 
 def _refresh_telemetry(extras):
-    """(Re)attach the metrics+span summary block. Called at every flush
-    and on the final result line, so EVERY artifact — complete, partial,
-    or error — carries the current timeline (a wedged grant produces a
-    diagnosable record instead of a bare error line)."""
+    """(Re)attach the metrics+span summary block AND the compiled-program
+    profile block. Called at every flush and on the final result line, so
+    EVERY artifact — complete, partial, or error — carries the current
+    timeline and every ProgramProfile collected so far (a section that
+    wedges mid-run still flushes the profiles its programs captured)."""
     try:
         extras["telemetry"] = _telemetry_summary()
     except Exception as e:  # telemetry must never break the bench
         _log(f"telemetry summary failed: {e}")
+    try:
+        from deeplearning4j_tpu.monitor.profile import (
+            profile_enabled, profiles)
+
+        extras["profile"] = {"enabled": profile_enabled(),
+                             "programs": profiles().snapshot()}
+    except Exception as e:  # profiling must never break the bench
+        _log(f"profile snapshot failed: {e}")
     return extras
 
 
@@ -1049,6 +1186,11 @@ def _uninstall_partial_emitter():
 def main() -> None:
     import os
 
+    # the bench IS the profiling run: capture every fused program's
+    # cost/memory analysis + chunk-boundary HBM watermarks unless the
+    # caller explicitly opted out (training entrypoints keep the
+    # DL4J_PROFILE=0 default — the unwrapped bitwise program)
+    os.environ.setdefault("DL4J_PROFILE", "1")
     _await_backend()
     extras = {"peak_tflops_bf16_per_chip": PEAK_TFLOPS_BF16,
               "chip": "TPU v5e (1 chip)"}

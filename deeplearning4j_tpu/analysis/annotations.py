@@ -43,6 +43,13 @@ def traced(fn: F) -> F:
 # program factory (its nested ``run`` is hot by containment), the
 # device_eval kernels, and the traced helpers they lean on. Keep this
 # list in sync with docs/static_analysis.md.
+#
+# profile-readback note: profile collection (monitor/profile
+# ``capture_program_profile``, monitor/memory ``sample_hbm_watermark``
+# and friends) is a host readback and is only permitted at CHUNK
+# BOUNDARIES — between fused dispatches, where drive_epoch_chunks calls
+# it. The host-sync rule flags any ``PROFILE_READBACK_CALLS`` name
+# (analysis/rules.py) reachable from these roots, exactly like float().
 HOT_PATH_REGISTRY = frozenset({
     # nn/multilayer.py + nn/graph.py fused-step surface
     "_step_impl",

@@ -271,12 +271,29 @@ SYNC_CALL_NAMES = {
 }
 SYNC_ATTR_CALLS = {"item", "block_until_ready", "tolist"}
 
+# profile-readback: the monitor/profile + monitor/memory collection
+# entry points (compile introspection, device memory_stats, live-array
+# walks) are host readbacks by design and are only permitted at CHUNK
+# BOUNDARIES — drive_epoch_chunks calls them between dispatches. Any of
+# these reachable from a hot root would serialize the fused program
+# behind a host sync, so the host-sync rule flags them like float().
+PROFILE_READBACK_CALLS = {
+    "capture_program_profile",
+    "sample_hbm_watermark",
+    "validate_cache_budget",
+    "cache_resident_bytes",
+    "live_array_bytes",
+}
+
 
 class HostSyncRule(Rule):
     id = "host-sync-in-hot-path"
     doc = ("host-synchronizing call (float()/.item()/np.asarray/"
-           "jax.device_get/block_until_ready/.tolist) reachable from a "
-           "@traced function or a HOT_PATH_REGISTRY root")
+           "jax.device_get/block_until_ready/.tolist, or a "
+           "profile-readback like sample_hbm_watermark/"
+           "capture_program_profile — chunk-boundary-only by contract) "
+           "reachable from a @traced function or a HOT_PATH_REGISTRY "
+           "root")
 
     def check(self, module: Module, config: LintConfig) -> List[Finding]:
         defs = list(iter_defs(module.tree))
@@ -337,6 +354,11 @@ class HostSyncRule(Rule):
                       and node.func.attr in SYNC_ATTR_CALLS):
                     msg = (f".{node.func.attr}() forces a device->host "
                            "sync")
+                elif (d and d.split(".")[-1] in PROFILE_READBACK_CALLS):
+                    msg = (f"{d}() is a profile-readback (compile "
+                           "introspection / device memory_stats) — "
+                           "profile collection is only permitted at "
+                           "chunk boundaries, never")
                 if msg:
                     scope = getattr(fn, "name", "<lambda>")
                     self.emit(out, module, node,

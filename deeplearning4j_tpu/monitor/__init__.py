@@ -25,6 +25,13 @@ exporter formats):
   separately by the network classes; this ``__init__`` stays
   stdlib-only so control-plane modules can import it before (or
   without) jax.
+- :mod:`~deeplearning4j_tpu.monitor.profile` — the compiled-program
+  observatory: per-program ``cost_analysis()``/``memory_analysis()``
+  profiles of every cached fused program (``DL4J_PROFILE``), compile
+  wall times, and the cost model's step-time decomposition.
+- :mod:`~deeplearning4j_tpu.monitor.memory` — HBM watermark sampling at
+  chunk boundaries (device ``memory_stats()`` / live-array accounting)
+  and the runtime check of the epoch-cache per-shard budget model.
 
 Env surface: ``DL4J_TELEMETRY`` (``on`` compiles the metrics pack into
 the fused step; default off = bitwise PR-5 program),
@@ -57,6 +64,19 @@ from deeplearning4j_tpu.monitor.exporters import (  # noqa: F401
     telemetry_summary,
     write_prometheus_textfile,
 )
+from deeplearning4j_tpu.monitor.profile import (  # noqa: F401
+    ProfiledProgram,
+    ProgramProfile,
+    capture_program_profile,
+    classify_boundedness,
+    flops_divergence_pct,
+    profile_enabled,
+    profiles,
+)
+from deeplearning4j_tpu.monitor.memory import (  # noqa: F401
+    sample_hbm_watermark,
+    validate_cache_budget,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
@@ -65,6 +85,9 @@ __all__ = [
     "telemetry_summary", "write_prometheus_textfile",
     "telemetry_enabled", "metrics_stride", "fused_metrics_stride",
     "record_counter",
+    "ProfiledProgram", "ProgramProfile", "capture_program_profile",
+    "classify_boundedness", "flops_divergence_pct", "profile_enabled",
+    "profiles", "sample_hbm_watermark", "validate_cache_budget",
 ]
 
 _ON = ("1", "on", "true", "yes")

@@ -668,13 +668,19 @@ class ComputationGraph:
                           guard: bool = False, metrics_stride: int = 0):
         """Jitted fused epoch program (one entry per (shuffle, accum,
         guard, metrics_stride)); params/updater/net state donated,
-        dataset stacks resident."""
+        dataset stacks resident. Entries are :class:`ProfiledProgram`s —
+        pass-through with ``DL4J_PROFILE`` off, cost/memory-profiled
+        once per signature with it on (monitor/profile.py)."""
+        from deeplearning4j_tpu.monitor.profile import ProfiledProgram
+
         key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard,
-                                            metrics_stride),
-                         donate_argnums=(0, 1, 2))
+            fn = ProfiledProgram(
+                jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard,
+                                           metrics_stride),
+                        donate_argnums=(0, 1, 2)),
+                name="ComputationGraph", key=key)
             self._epoch_steps[key] = fn
         return fn
 

@@ -641,13 +641,21 @@ class MultiLayerNetwork:
                           guard: bool = False, metrics_stride: int = 0):
         """Jitted fused epoch program (one entry per (shuffle, accum,
         guard, metrics_stride)); params/updater/net state are donated; the
-        dataset stacks are NOT (they stay in HBM across chunks)."""
+        dataset stacks are NOT (they stay in HBM across chunks). Cached
+        entries are :class:`ProfiledProgram`s: with ``DL4J_PROFILE`` off
+        every call passes through to the jit function untouched; on, each
+        program's cost/memory analysis is captured once per signature
+        (monitor/profile.py)."""
+        from deeplearning4j_tpu.monitor.profile import ProfiledProgram
+
         key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            fn = jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard,
-                                            metrics_stride),
-                         donate_argnums=(0, 1, 2))
+            fn = ProfiledProgram(
+                jax.jit(self._epoch_run_fn(shuffle, accum_steps, guard,
+                                           metrics_stride),
+                        donate_argnums=(0, 1, 2)),
+                name="MultiLayerNetwork", key=key)
             self._epoch_steps[key] = fn
         return fn
 

@@ -232,6 +232,8 @@ class ParallelWrapper:
         trip history — replicated like the loss history; the telemetry
         metrics pack (``metrics_stride``) appends another replicated
         ``[E, N, 4]`` output after it."""
+        from deeplearning4j_tpu.monitor.profile import ProfiledProgram
+
         key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
@@ -245,10 +247,12 @@ class ParallelWrapper:
                 out = out + (repl,)
             if metrics_stride:
                 out = out + (repl,)
-            fn = jax.jit(self.network._epoch_run_fn(shuffle, accum_steps,
-                                                    guard, metrics_stride),
-                         donate_argnums=(0, 1, 2) if self._donate else (),
-                         out_shardings=out)
+            fn = ProfiledProgram(
+                jax.jit(self.network._epoch_run_fn(shuffle, accum_steps,
+                                                   guard, metrics_stride),
+                        donate_argnums=(0, 1, 2) if self._donate else (),
+                        out_shardings=out),
+                name="ParallelWrapper", key=key)
             self._epoch_steps[key] = fn
         return fn
 

@@ -551,6 +551,8 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     from deeplearning4j_tpu.resilience import faults
     from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 
+    from deeplearning4j_tpu.monitor.profile import profile_enabled
+
     if chunk_epochs is None:
         chunk_epochs = 1 if net.listeners else num_epochs
     chunk_epochs = max(1, min(int(chunk_epochs), num_epochs))
@@ -560,6 +562,11 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     metrics_chunks = []
     net._last_sentinel = None
     net._last_metrics = None
+    # HBM watermarks sample ONLY at chunk boundaries (host-side, after
+    # the dispatch) and only under DL4J_PROFILE — the default path never
+    # pays the memory_stats/live-array walk
+    profiling = profile_enabled()
+    net._hbm_watermarks = [] if profiling else None
     # skip takes no per-chunk action — keep its trip reads off the hot
     # path (device arrays accumulate; one sync at end of run)
     defer_inspect = guard not in ("halve_lr", "raise")
@@ -595,6 +602,12 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                 net._train_dispatches += 1
                 record_counter("train_chunk_dispatches_total",
                                model=model_name)
+                if profiling:
+                    from deeplearning4j_tpu.monitor.memory import (
+                        sample_hbm_watermark)
+
+                    net._hbm_watermarks.append(
+                        sample_hbm_watermark(tag="epoch.chunk"))
                 net.iteration_count += k * cache.n_batches
                 net._score = hist[-1, -1]  # device scalar
                 if mets is not None:

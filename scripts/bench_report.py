@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Bench-trajectory report: round-over-round table + regression gate.
+
+The BENCH_r01..r05 trajectory degraded silently: rounds 4-5 recorded
+wedged-grant error lines and nothing machine-readable ever diffed one
+round against the last honest one. This reads every ``BENCH_r*.json``
+(the driver sidecar shape ``{n, rc, tail, parsed}``; bare result lines
+``{metric, value, extras}`` are accepted too, so synthetic fixtures and
+fresh ``bench.py`` output both feed it), classifies each round —
+
+- ``ok``     a result line with a non-null headline value and no error
+- ``wedge``  an explicit backend-unavailable / wedged-grant error line
+- ``error``  no parseable result line, a nonzero rc, or any other error
+
+— prints the trajectory table (headline value, per-section samples/sec,
+MFU, guard/telemetry overhead), and with ``--check`` exits nonzero when
+the LATEST ok round regresses more than ``--threshold-pct`` against the
+best earlier ok round on any tracked higher-is-better series. Wedge and
+error rounds are called out but never scored (a wedge is an
+infrastructure fact, not a perf regression) and never used as a
+baseline.
+
+Usage:
+    python scripts/bench_report.py BENCH_r*.json           # table only
+    python scripts/bench_report.py --check BENCH_r*.json   # gate (rc 1
+                                                           # on regression)
+    python scripts/bench_report.py --check --threshold-pct 10 ...
+
+Exit codes: 0 clean, 1 regression found (``--check``), 2 usage/load
+error. Wired into ``scripts/verify.sh --profile``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+WEDGE_MARKERS = ("backend unavailable", "wedge", "did not complete")
+
+# (label, extractor) — every tracked series is higher-is-better; the
+# extractor returns None when the round has no honest value for it
+TRACKED = [
+    ("headline", lambda r: r["value"] if r["status"] == "ok" else None),
+    ("transformer_mfu_pct",
+     lambda r: _dig(r, "transformer_lm", "mfu_pct")),
+    ("transformer_tokens_per_sec",
+     lambda r: _dig(r, "transformer_lm", "tokens_per_sec")),
+    ("resnet18_mfu_pct",
+     lambda r: _dig(r, "resnet18_cifar10", "mfu_pct")),
+    ("resnet18_samples_per_sec",
+     lambda r: _dig(r, "resnet18_cifar10", "samples_per_sec")),
+    ("mnist_mlp_samples_per_sec",
+     lambda r: _dig(r, "mnist_mlp", "samples_per_sec")),
+    ("lenet5_samples_per_sec",
+     lambda r: _dig(r, "lenet5", "samples_per_sec")),
+    ("gemm_peak_tflops",
+     lambda r: _dig(r, "gemm", "peak_achieved_tflops")),
+    ("epoch_speedup",
+     lambda r: _dig(r, "epoch", "speedup")),
+    ("dp_epoch_samples_per_sec_per_chip",
+     lambda r: _dig(r, "dp_epoch", "samples_per_sec_per_chip")),
+]
+
+# lower-is-better overhead columns: reported in the table, not gated
+OVERHEADS = [
+    ("guard_overhead_pct", ("guard", "sentinel_overhead_pct")),
+    ("telemetry_overhead_pct", ("telemetry", "pack_overhead_pct")),
+]
+
+
+def _dig(row: dict, section: str, field: str):
+    sec = (row.get("extras") or {}).get(section)
+    if not isinstance(sec, dict) or "error" in sec:
+        return None
+    val = sec.get(field)
+    return float(val) if isinstance(val, (int, float)) else None
+
+
+def _round_number(path: str, payload: dict) -> Optional[int]:
+    n = payload.get("n")
+    if isinstance(n, int):
+        return n
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_round(path: str) -> dict:
+    """One BENCH file -> a normalized row. Accepts the driver sidecar
+    shape ({n, rc, tail, parsed}) and a bare result line."""
+    with open(path) as f:
+        payload = json.load(f)
+    if "parsed" in payload or "rc" in payload:
+        parsed = payload.get("parsed")
+        rc = payload.get("rc", 0)
+    else:  # a bare bench.py result line
+        parsed = payload
+        rc = 0
+    row = {
+        "path": path,
+        "round": _round_number(path, payload),
+        "rc": rc,
+        "metric": None,
+        "value": None,
+        "unit": None,
+        "extras": {},
+        "note": "",
+    }
+    if isinstance(parsed, dict):
+        row["metric"] = parsed.get("metric")
+        row["value"] = parsed.get("value")
+        row["unit"] = parsed.get("unit")
+        row["extras"] = parsed.get("extras") or {}
+    err = (row["extras"].get("error") or "") if row["extras"] else ""
+    if parsed is None:
+        row["status"] = "error"
+        row["note"] = f"no result line (rc={rc})"
+    elif err and any(m in err.lower() for m in WEDGE_MARKERS):
+        row["status"] = "wedge"
+        row["note"] = err[:90]
+    elif err or row["value"] is None or rc != 0:
+        row["status"] = "error"
+        row["note"] = (err or f"null value (rc={rc})")[:90]
+    else:
+        row["status"] = "ok"
+    return row
+
+
+def build_series(rows: List[dict]) -> Dict[str, List[Tuple[int, float]]]:
+    """{series label: [(round, value), ...]} over ok rounds only, and
+    only where the round's headline METRIC matches for the headline
+    series (r01's lenet headline and r03's transformer headline are
+    different experiments, not a trajectory)."""
+    series: Dict[str, List[Tuple[int, float]]] = {}
+    for label, extract in TRACKED:
+        pts = []
+        for row in rows:
+            # unnumbered rounds cannot be ordered into a trajectory
+            if row["status"] != "ok" or row["round"] is None:
+                continue
+            val = extract(row)
+            if val is not None:
+                key = label
+                if label == "headline":
+                    key = f"headline:{row['metric']}"
+                pts.append((key, row["round"], val))
+        for key, rnd, val in pts:
+            series.setdefault(key, []).append((rnd, val))
+    return series
+
+
+def find_regressions(series: Dict[str, List[Tuple[int, float]]],
+                     threshold_pct: float) -> List[str]:
+    """Latest ok point vs the best EARLIER ok point per series; a drop
+    beyond the threshold is a regression."""
+    out = []
+    for label, pts in sorted(series.items()):
+        pts = sorted(pts)
+        if len(pts) < 2:
+            continue
+        (last_round, last), earlier = pts[-1], pts[:-1]
+        best_round, best = max(earlier, key=lambda p: p[1])
+        if best <= 0:
+            continue
+        drop_pct = 100.0 * (best - last) / best
+        if drop_pct > threshold_pct:
+            out.append(
+                f"{label}: r{last_round:02d} = {last:,.1f} is "
+                f"{drop_pct:.1f}% below r{best_round:02d} = {best:,.1f} "
+                f"(threshold {threshold_pct:.0f}%)")
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and abs(v) >= 1000:
+        return f"{v:,.0f}"
+    return f"{v:g}"
+
+
+def print_table(rows: List[dict], out=None) -> None:
+    out = out or sys.stdout
+    cols = ["round", "status", "headline", "value", "tf_mfu%",
+            "rn_mfu%", "guard_ov%", "telem_ov%", "note"]
+    table = []
+    for row in rows:
+        table.append([
+            f"r{row['round']:02d}" if row["round"] is not None else "?",
+            row["status"].upper() if row["status"] != "ok" else "ok",
+            (row["metric"] or "-")[:44],
+            _fmt(row["value"]),
+            _fmt(_dig(row, "transformer_lm", "mfu_pct")),
+            _fmt(_dig(row, "resnet18_cifar10", "mfu_pct")),
+            _fmt(_dig(row, *OVERHEADS[0][1])),
+            _fmt(_dig(row, *OVERHEADS[1][1])),
+            row["note"],
+        ])
+    widths = [max(len(str(r[i])) for r in [cols] + table)
+              for i in range(len(cols))]
+    for r in [cols] + table:
+        print("  ".join(str(c).ljust(w) for c, w in zip(r, widths)),
+              file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench trajectory table + regression gate")
+    ap.add_argument("files", nargs="+", help="BENCH_r*.json artifacts")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when a tracked series regresses")
+    ap.add_argument("--threshold-pct", type=float, default=20.0,
+                    help="regression threshold (default 20%%)")
+    args = ap.parse_args(argv)
+
+    rows = []
+    for path in sorted(args.files):
+        try:
+            rows.append(load_round(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_report: cannot load {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    rows.sort(key=lambda r: (r["round"] is None, r["round"]))
+
+    print_table(rows)
+    bad = [r for r in rows if r["status"] != "ok"]
+    if bad:
+        print()
+        for row in bad:
+            rid = (f"r{row['round']:02d}" if row["round"] is not None
+                   else "r??")
+            print(f"  !! {rid} is a "
+                  f"{row['status'].upper()} round — excluded from "
+                  f"regression scoring: {row['note']}")
+
+    regressions = find_regressions(build_series(rows),
+                                   args.threshold_pct)
+    if regressions:
+        print("\nREGRESSIONS:")
+        for r in regressions:
+            print(f"  {r}")
+        if args.check:
+            return 1
+    elif args.check:
+        print("\nno regressions beyond "
+              f"{args.threshold_pct:.0f}% across "
+              f"{sum(1 for r in rows if r['status'] == 'ok')} ok "
+              f"round(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,9 +30,15 @@
 #                                      rule-engine fixtures in
 #                                      tests/test_analysis.py; nonzero
 #                                      exit on any NEW finding)
-# The eval/epoch/dp/heal/obs/lint tests are part of the default tier-1
-# run; --eval/--epoch/--dp/--heal/--obs/--lint are the narrow fast paths
-# for iterating on those surfaces.
+#        scripts/verify.sh --profile  (performance observatory: the
+#                                      ProgramProfile/HBM-watermark
+#                                      suite + bench_report.py --check
+#                                      over the committed BENCH_r*.json
+#                                      trajectory; nonzero exit on a
+#                                      bench regression)
+# The eval/epoch/dp/heal/obs/lint/profile tests are part of the default
+# tier-1 run; --eval/--epoch/--dp/--heal/--obs/--lint/--profile are the
+# narrow fast paths for iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -64,6 +70,13 @@ elif [ "${1:-}" = "--lint" ]; then
     # seeded-violation fixtures that keep the rules themselves honest
     python scripts/dl4j_lint.py || exit 1
     TARGET=tests/test_analysis.py
+elif [ "${1:-}" = "--profile" ]; then
+    shift
+    TARGET=tests/test_profile.py
+    # the trajectory gate rides along: the committed BENCH artifacts
+    # must show no silent round-over-round regression (wedge/error
+    # rounds are called out but never scored)
+    python scripts/bench_report.py --check BENCH_r*.json || exit 1
 fi
 
 rm -f /tmp/_t1.log

@@ -37,7 +37,13 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
   - telemetry: in-program metrics-pack overhead (on vs off, <3%
     target) + exporter round-trip; every artifact this bench writes —
     including partials and error lines — embeds a metrics+span summary
-    block ("telemetry" key) with the grant-acquisition timeline
+    block ("telemetry" key) with the grant-acquisition timeline AND
+    the run-ledger goodput/badput report
+  - flight: run-ledger + flight-recorder overhead (recorder on vs off,
+    <3% target) + the postmortem round trip (completed run's segments
+    classify "clean"); grant acquisition drops open "grant.wait"
+    markers into the recorder so a wedged grant is classifiable from
+    the surviving segments alone (scripts/flight_report.py)
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs come from the COMPILED program's ``cost_analysis()`` when the
@@ -68,6 +74,7 @@ import numpy as np
 # acquisition are exactly the wedge-timeline evidence BENCH_r04/r05
 # lacked
 from deeplearning4j_tpu.monitor import (
+    flight_record as _flight_record,
     record_counter as _record_counter,
     telemetry_summary as _telemetry_summary,
     tracer as _tracer,
@@ -742,6 +749,83 @@ def bench_telemetry():
             "batch": batch, "n_batches": n_batches, "epochs": epochs}
 
 
+def bench_flight():
+    """Run-observability overhead: fused-epoch throughput with the
+    flight recorder live (DL4J_FLIGHT-equivalent: every chunk boundary,
+    span, and ledger transition streaming to the on-disk segment ring)
+    vs off — the budget is <3% like the sentinel and the metrics pack.
+    The run ledger itself is always on (host-side arithmetic), so the
+    delta isolates the recorder. Also reports the ledger's goodput for
+    the timed run, the recorder's write stats, and a postmortem round
+    trip: the completed run's surviving segments must classify as
+    ``clean``."""
+    import tempfile
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models import mnist_mlp
+    from deeplearning4j_tpu.monitor.flight import (
+        FlightRecorder, classify_end_state, load_flight_records,
+        set_flight)
+    from deeplearning4j_tpu.monitor.ledger import run_ledger
+    from deeplearning4j_tpu.perf.epoch_cache import DeviceDataSetCache
+
+    rng = np.random.default_rng(0)
+    batch, n_batches, epochs = 2048, 16, 5
+    ds = DataSet(rng.random((batch * n_batches, 784), np.float32),
+                 np.eye(10, dtype=np.float32)[
+                     rng.integers(0, 10, batch * n_batches)])
+    total = batch * n_batches
+
+    def prep():
+        net = mnist_mlp(hidden=256, dtype_policy="bf16").init()
+        cache = DeviceDataSetCache.build(ListDataSetIterator(ds, batch))
+        assert cache is not None, "bench dataset exceeded DL4J_DEVICE_CACHE_MB"
+        net.fit_epochs(cache, epochs, chunk_epochs=1)
+        _sync(net.params)  # warm: compile outside the timing
+        return net, cache
+
+    def timed(net, cache):
+        t0 = time.perf_counter()
+        net.fit_epochs(cache, epochs, chunk_epochs=1)
+        _sync(net.params)
+        return total * epochs / (time.perf_counter() - t0)
+
+    off_net, off_cache = prep()
+    on_net, on_cache = prep()
+    # best-of-3, interleaved: host timing jitter dwarfs a few-% delta
+    off_sps = max(timed(off_net, off_cache) for _ in range(3))
+    with tempfile.TemporaryDirectory() as d:
+        recorder = FlightRecorder(d)
+        set_flight(recorder)
+        try:
+            on_sps = max(timed(on_net, on_cache) for _ in range(3))
+        finally:
+            set_flight(None)
+            recorder.close()
+        records = load_flight_records(d)
+        end_state = classify_end_state(records)["end_state"]
+    overhead_pct = (off_sps / on_sps - 1.0) * 100.0
+    goodput = run_ledger().last_run_goodput()
+
+    _log(f"flight: {on_sps:,.0f} samples/sec recorded vs {off_sps:,.0f} "
+         f"unrecorded ({overhead_pct:+.2f}% overhead, target <3%); "
+         f"{recorder.records_written} records, "
+         f"{recorder.segments_rotated} rotations, goodput "
+         f"{goodput if goodput is not None else float('nan'):.1f}%, "
+         f"postmortem={end_state}")
+    return {"recorded_samples_per_sec": round(on_sps, 1),
+            "unrecorded_samples_per_sec": round(off_sps, 1),
+            "flight_overhead_pct": round(overhead_pct, 2),
+            "overhead_within_target": bool(overhead_pct < 3.0),
+            "records_written": recorder.records_written,
+            "records_dropped": recorder.records_dropped,
+            "segments_rotated": recorder.segments_rotated,
+            "goodput_pct": goodput,
+            "postmortem_end_state": end_state,
+            "batch": batch, "n_batches": n_batches, "epochs": epochs}
+
+
 def bench_eval():
     """Inference/eval path: device-resident confusion accumulation vs the
     host path (per-batch logit readback) on a stream of ragged batches.
@@ -1048,7 +1132,12 @@ def _await_backend(timeout_s: float = None):
         probe_s = min(timeout_s, 90.0)
     # grant-acquisition spans: the BENCH_r04/r05 wedge class is a grant
     # that blocks for hours — these spans (and the watchdog events on
-    # timeout) make the wedge diagnosable from the JSON artifact alone
+    # timeout) make the wedge diagnosable from the JSON artifact alone.
+    # The flight marker lands BEFORE the blocking call: spans only
+    # record on completion, so a grant that never returns would leave
+    # no span — the open marker (plus continuing writer heartbeats) is
+    # what flight_report classifies the wedge from.
+    _flight_record("grant.wait", phase="probe", timeout_s=probe_s)
     with _tracer().span("grant.probe", timeout_s=probe_s) as sp:
         ok, detail = _probe_backend_subprocess(probe_s)
         sp.attrs["ok"] = ok
@@ -1080,6 +1169,7 @@ def _await_backend(timeout_s: float = None):
             result["error"] = str(e)[:300]
         ready.set()
 
+    _flight_record("grant.wait", phase="acquire", timeout_s=timeout_s)
     with _tracer().span("grant.acquire", timeout_s=timeout_s) as sp:
         threading.Thread(target=probe, daemon=True).start()
         acquired = ready.wait(timeout_s) and "error" not in result
@@ -1216,7 +1306,8 @@ def main() -> None:
                 ("epoch", bench_epoch),
                 ("dp_epoch", bench_dp_epoch),
                 ("guard", bench_guard),
-                ("telemetry", bench_telemetry)]
+                ("telemetry", bench_telemetry),
+                ("flight", bench_flight)]
     if only:
         known = {n for n, _ in sections} | {"transformer"}
         unknown = sorted(only - known)

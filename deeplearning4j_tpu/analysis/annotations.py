@@ -50,6 +50,10 @@ def traced(fn: F) -> F:
 # BOUNDARIES — between fused dispatches, where drive_epoch_chunks calls
 # it. The host-sync rule flags any ``PROFILE_READBACK_CALLS`` name
 # (analysis/rules.py) reachable from these roots, exactly like float().
+# The same contract covers the run-ledger boundary marks and flight-
+# recorder writes (``LEDGER_FLIGHT_CALLS``: ledger_run_start/
+# ledger_chunk_start/ledger_chunk_done/ledger_run_end/flight_record) —
+# chunk-boundary-only, never inside a traced program.
 HOT_PATH_REGISTRY = frozenset({
     # nn/multilayer.py + nn/graph.py fused-step surface
     "_step_impl",

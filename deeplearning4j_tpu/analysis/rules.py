@@ -285,15 +285,30 @@ PROFILE_READBACK_CALLS = {
     "live_array_bytes",
 }
 
+# ledger/flight collection: the run-ledger boundary marks and flight-
+# recorder writes (monitor/ledger, monitor/flight) are host-side
+# control-plane calls permitted ONLY at chunk boundaries — the same
+# contract as the profile readbacks. One of these traced into a fused
+# program would compile a host callback (or a spurious constant) into
+# E*N steps.
+LEDGER_FLIGHT_CALLS = {
+    "ledger_run_start",
+    "ledger_chunk_start",
+    "ledger_chunk_done",
+    "ledger_run_end",
+    "flight_record",
+}
+
 
 class HostSyncRule(Rule):
     id = "host-sync-in-hot-path"
     doc = ("host-synchronizing call (float()/.item()/np.asarray/"
-           "jax.device_get/block_until_ready/.tolist, or a "
+           "jax.device_get/block_until_ready/.tolist, a "
            "profile-readback like sample_hbm_watermark/"
-           "capture_program_profile — chunk-boundary-only by contract) "
-           "reachable from a @traced function or a HOT_PATH_REGISTRY "
-           "root")
+           "capture_program_profile, or a ledger/flight collection "
+           "call like ledger_chunk_done/flight_record — "
+           "chunk-boundary-only by contract) reachable from a @traced "
+           "function or a HOT_PATH_REGISTRY root")
 
     def check(self, module: Module, config: LintConfig) -> List[Finding]:
         defs = list(iter_defs(module.tree))
@@ -359,6 +374,11 @@ class HostSyncRule(Rule):
                            "introspection / device memory_stats) — "
                            "profile collection is only permitted at "
                            "chunk boundaries, never")
+                elif (d and d.split(".")[-1] in LEDGER_FLIGHT_CALLS):
+                    msg = (f"{d}() is a run-ledger/flight-recorder "
+                           "collection call — ledger transitions and "
+                           "flight records are only permitted at chunk "
+                           "boundaries, never")
                 if msg:
                     scope = getattr(fn, "name", "<lambda>")
                     self.emit(out, module, node,

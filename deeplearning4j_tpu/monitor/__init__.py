@@ -32,6 +32,14 @@ exporter formats):
 - :mod:`~deeplearning4j_tpu.monitor.memory` — HBM watermark sampling at
   chunk boundaries (device ``memory_stats()`` / live-array accounting)
   and the runtime check of the epoch-cache per-shard budget model.
+- :mod:`~deeplearning4j_tpu.monitor.ledger` — the run-level goodput/
+  badput ledger: every wall-clock second of a fused run classified by
+  state from the span taxonomy plus chunk-boundary marks; the report
+  rides in ``telemetry_summary()``.
+- :mod:`~deeplearning4j_tpu.monitor.flight` — the crash-surviving
+  flight recorder (``DL4J_FLIGHT``): a bounded segment-rotated on-disk
+  ring of spans/events/ledger transitions; ``scripts/flight_report.py``
+  classifies a dead run's end state from the surviving segments.
 
 Env surface: ``DL4J_TELEMETRY`` (``on`` compiles the metrics pack into
 the fused step; default off = bitwise PR-5 program),
@@ -77,6 +85,23 @@ from deeplearning4j_tpu.monitor.memory import (  # noqa: F401
     sample_hbm_watermark,
     validate_cache_budget,
 )
+from deeplearning4j_tpu.monitor.ledger import (  # noqa: F401
+    RunLedger,
+    ledger_chunk_done,
+    ledger_chunk_start,
+    ledger_run_end,
+    ledger_run_start,
+    run_ledger,
+    set_run_ledger,
+)
+from deeplearning4j_tpu.monitor.flight import (  # noqa: F401
+    FlightRecorder,
+    classify_end_state,
+    flight,
+    flight_record,
+    load_flight_records,
+    set_flight,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
@@ -88,6 +113,10 @@ __all__ = [
     "ProfiledProgram", "ProgramProfile", "capture_program_profile",
     "classify_boundedness", "flops_divergence_pct", "profile_enabled",
     "profiles", "sample_hbm_watermark", "validate_cache_budget",
+    "RunLedger", "ledger_chunk_done", "ledger_chunk_start",
+    "ledger_run_end", "ledger_run_start", "run_ledger", "set_run_ledger",
+    "FlightRecorder", "classify_end_state", "flight", "flight_record",
+    "load_flight_records", "set_flight",
 ]
 
 _ON = ("1", "on", "true", "yes")

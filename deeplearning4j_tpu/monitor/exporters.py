@@ -51,21 +51,56 @@ def telemetry_dir() -> Optional[str]:
 
 
 class JsonlExporter:
-    """Append-only JSON-lines writer (thread-safe, best-effort I/O)."""
+    """Append-only JSON-lines writer (thread-safe, best-effort I/O).
 
-    def __init__(self, path: str):
+    Disk use is BOUNDED: once the file exceeds ``max_bytes`` it rotates
+    through the flight recorder's shift mechanism (``path`` →
+    ``path.1`` → … up to ``backups`` files, oldest overwritten), so an
+    always-on span sink can no longer grow ``telemetry.jsonl`` without
+    limit. Defaults come from the shared segment knobs
+    (``DL4J_FLIGHT_SEGMENT_KB`` / ``DL4J_FLIGHT_SEGMENTS``); pass
+    ``max_bytes=0`` for the legacy unbounded behavior."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 backups: Optional[int] = None):
+        from deeplearning4j_tpu.monitor.flight import (
+            max_segments, segment_bytes)
+
         self.path = path
+        self.max_bytes = (segment_bytes() if max_bytes is None
+                          else int(max_bytes))
+        self.backups = (max_segments() - 1 if backups is None
+                        else max(0, int(backups)))
         self._lock = threading.Lock()
+        self._size: Optional[int] = None
         self._warned = False
 
     def write(self, record: dict) -> None:
-        line = json.dumps(record, default=_json_default)
+        from deeplearning4j_tpu.monitor.flight import shift_rotate
+
+        line = json.dumps(record, default=_json_default) + "\n"
         try:
             with self._lock:
                 os.makedirs(os.path.dirname(self.path) or ".",
                             exist_ok=True)
+                if self._size is None:
+                    try:
+                        self._size = os.path.getsize(self.path)
+                    except OSError:
+                        self._size = 0
+                if (self.max_bytes > 0 and self._size > 0
+                        and self._size + len(line) > self.max_bytes):
+                    try:
+                        shift_rotate(self.path, self.backups)
+                    except FileNotFoundError:
+                        # the live file vanished externally (operator
+                        # cleanup, foreign logrotate): nothing to
+                        # rotate — fall through and recreate it
+                        pass
+                    self._size = 0
                 with open(self.path, "a") as f:
-                    f.write(line + "\n")
+                    f.write(line)
+                self._size += len(line)
         except OSError as e:
             if not self._warned:  # complain once, not per event
                 self._warned = True
@@ -143,7 +178,8 @@ def write_prometheus_textfile(registry=None, path: Optional[str] = None
 def telemetry_summary(registry=None, span_tracer=None,
                       recent_spans: int = 40) -> dict:
     """The metrics+span summary block bench artifacts embed: registry
-    snapshot, per-span-name aggregates, and the recent-span timeline."""
+    snapshot, per-span-name aggregates, the recent-span timeline, and
+    the run ledger's goodput/badput report."""
     if registry is None:
         from deeplearning4j_tpu.monitor.registry import metrics
 
@@ -152,7 +188,14 @@ def telemetry_summary(registry=None, span_tracer=None,
         from deeplearning4j_tpu.monitor.trace import tracer
 
         span_tracer = tracer()
-    return {
+    out = {
         "metrics": registry.snapshot(),
         "spans": span_tracer.summary(recent=recent_spans),
     }
+    try:
+        from deeplearning4j_tpu.monitor.ledger import run_ledger
+
+        out["ledger"] = run_ledger().report(spans=span_tracer.spans())
+    except Exception as e:  # the ledger must never break an artifact
+        logger.warning("run-ledger report failed: %s", e)
+    return out

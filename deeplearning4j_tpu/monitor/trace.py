@@ -112,6 +112,19 @@ class SpanTracer:
                 # the sink is best-effort I/O; a full disk must not turn
                 # into a training failure
                 pass
+        # the flight recorder (when enabled) gets every finished span —
+        # the postmortem timeline a crash is reconstructed from.
+        # (import from the submodule: the package re-exports a `flight`
+        # FUNCTION that shadows the module attribute of the same name)
+        try:
+            from deeplearning4j_tpu.monitor.flight import (
+                flight as _active_flight)
+
+            rec = _active_flight()
+            if rec is not None:
+                rec.record_span(span.to_dict())
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     @contextmanager

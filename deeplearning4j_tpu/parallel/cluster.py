@@ -94,15 +94,26 @@ def initialize_distributed(config: ClusterConfig, retries: int = 3,
 
 class HeartbeatMonitor:
     """Posts worker heartbeats on a timer; the coordinator side calls
-    ``evict()`` to drop silent workers and requeue their jobs."""
+    ``evict()`` to drop silent workers and requeue their jobs.
+
+    ``payload_fn`` (optional) is called before every beat and its dict
+    rides along as the beat's compact metrics payload (step time,
+    goodput, last-chunk loss — whatever the worker wants the master's
+    fleet view to see). A failing ``payload_fn`` degrades to a
+    payload-less beat — liveness must never depend on telemetry — and a
+    tracker whose ``heartbeat`` predates the ``metrics=`` parameter
+    gets the legacy payload-less call."""
 
     def __init__(self, tracker: StateTracker, worker_id: str,
                  interval_s: float = 5.0,
-                 eviction_timeout_s: float = DEFAULT_EVICTION_TIMEOUT_S):
+                 eviction_timeout_s: float = DEFAULT_EVICTION_TIMEOUT_S,
+                 payload_fn: Optional[Callable[[], Optional[dict]]] = None):
         self.tracker = tracker
         self.worker_id = worker_id
         self.interval_s = interval_s
         self.eviction_timeout_s = eviction_timeout_s
+        self.payload_fn = payload_fn
+        self._supports_metrics: Optional[bool] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -117,11 +128,42 @@ class HeartbeatMonitor:
         # liveness must degrade, not crash: a transient tracker error
         # (shared-fs hiccup, injected fault) skips one beat and keeps the
         # thread alive — eviction only triggers after MANY missed beats
+        payload = None
+        if self.payload_fn is not None:
+            try:
+                payload = self.payload_fn()
+            except Exception:  # noqa: BLE001 — telemetry never blocks liveness
+                logger.debug("heartbeat payload_fn failed for %s; "
+                             "posting payload-less beat", self.worker_id,
+                             exc_info=True)
         try:
-            self.tracker.heartbeat(self.worker_id)
+            if payload is None or not self._tracker_takes_metrics():
+                self.tracker.heartbeat(self.worker_id)
+            else:
+                self.tracker.heartbeat(self.worker_id, metrics=payload)
         except Exception:  # noqa: BLE001
             logger.warning("heartbeat post failed for %s (will retry on "
                            "next interval)", self.worker_id, exc_info=True)
+
+    def _tracker_takes_metrics(self) -> bool:
+        # signature inspection, cached, instead of catching TypeError
+        # from the live call: a TypeError the tracker itself raises
+        # (e.g. a non-JSON-serializable payload value) must surface as
+        # a warning, not be misread as "pre-payload implementation" and
+        # silently demote every future beat to payload-less
+        if self._supports_metrics is None:
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    self.tracker.heartbeat).parameters.values()
+                self._supports_metrics = any(
+                    p.name == "metrics"
+                    or p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params)
+            except (TypeError, ValueError):  # uninspectable callable
+                self._supports_metrics = True
+        return self._supports_metrics
 
     def start(self) -> "HeartbeatMonitor":
         if self._thread is not None:
@@ -287,9 +329,14 @@ class FaultTolerantTrainer:
         filesystem apart from a wedged chunk."""
         from deeplearning4j_tpu.utils.serializer import ModelSerializer
 
+        # background=True marks writes on the save_async writer thread:
+        # they overlap compute, so the run ledger books them as hidden
+        # rather than checkpoint badput
         with tracer().span("checkpoint.write",
                            path=os.path.basename(path),
-                           iteration=model.iteration_count) as sp:
+                           iteration=model.iteration_count,
+                           background=threading.current_thread().name
+                           .startswith("ckpt-writer")) as sp:
             tmp = path + ".tmp"
             ModelSerializer.write_model(model, tmp, save_updater=True)
             os.replace(tmp, path)

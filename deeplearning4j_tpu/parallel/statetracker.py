@@ -106,11 +106,24 @@ class StateTracker:
         raise NotImplementedError
 
     # --- heartbeats / liveness ---
-    def heartbeat(self, worker_id: str) -> None:
+    def heartbeat(self, worker_id: str,
+                  metrics: Optional[Dict[str, Any]] = None) -> None:
+        """Post liveness; ``metrics`` (optional) is a COMPACT payload —
+        step time, goodput, last-chunk loss — the master's fleet view
+        aggregates. Payload-less beats remain fully supported (and are
+        the cheap path); backends that predate the parameter still
+        satisfy the liveness half of the contract."""
         raise NotImplementedError
 
     def last_heartbeat(self, worker_id: str) -> Optional[float]:
         raise NotImplementedError
+
+    def heartbeat_metrics(self, worker_id: str
+                          ) -> Optional[Dict[str, Any]]:
+        """The metrics payload of the worker's newest beat, or None
+        (payload-less beat, unknown worker, or a backend without
+        payload support — the default)."""
+        return None
 
     def workers(self) -> List[str]:
         raise NotImplementedError
@@ -171,6 +184,7 @@ class InMemoryStateTracker(StateTracker):
         self._jobs: Dict[str, Job] = {}
         self._order: List[str] = []
         self._beats: Dict[str, float] = {}
+        self._beat_metrics: Dict[str, Dict[str, Any]] = {}
         self._meta: Dict[str, Any] = {}
         self._updates: Dict[str, Any] = {}
         self._arrays: Dict[str, Any] = {}
@@ -212,14 +226,29 @@ class InMemoryStateTracker(StateTracker):
                 out = [j for j in out if j.status == status]
             return [Job(**j.to_json()) for j in out]
 
-    def heartbeat(self, worker_id: str) -> None:
+    def heartbeat(self, worker_id: str,
+                  metrics: Optional[Dict[str, Any]] = None) -> None:
         faults.fault_point("heartbeat.post")
         with self._lock:
             self._beats[worker_id] = time.time()
+            if metrics is not None:
+                self._beat_metrics[worker_id] = dict(metrics)
+            else:
+                # a payload-less beat REPLACES the previous payload
+                # (same overwrite semantics as the file backend's beat
+                # file): heartbeat_metrics reports the newest beat, not
+                # a stale snapshot from a worker whose payload_fn died
+                self._beat_metrics.pop(worker_id, None)
 
     def last_heartbeat(self, worker_id: str) -> Optional[float]:
         with self._lock:
             return self._beats.get(worker_id)
+
+    def heartbeat_metrics(self, worker_id: str
+                          ) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            m = self._beat_metrics.get(worker_id)
+            return None if m is None else dict(m)
 
     def workers(self) -> List[str]:
         with self._lock:
@@ -232,6 +261,7 @@ class InMemoryStateTracker(StateTracker):
                      if now - t >= timeout_s]
             for w in stale:
                 del self._beats[w]
+                self._beat_metrics.pop(w, None)
                 for j in self._jobs.values():
                     if j.worker_id == w and j.status == "claimed":
                         j.status = "pending"
@@ -417,7 +447,8 @@ class FileStateTracker(StateTracker):
     def _beat_path(self, worker_id: str) -> str:
         return os.path.join(self.root, "beats", worker_id)
 
-    def heartbeat(self, worker_id: str) -> None:
+    def heartbeat(self, worker_id: str,
+                  metrics: Optional[Dict[str, Any]] = None) -> None:
         faults.fault_point("heartbeat.post")
 
         # beats bypass the statetracker.write fault point: background
@@ -428,19 +459,49 @@ class FileStateTracker(StateTracker):
         # data overwritten every interval — two fsyncs per beat would
         # throttle the control plane on NFS/gcsfuse for durability nobody
         # reads back.
+        #
+        # Payload-less beats keep the legacy bare-float format (cheap,
+        # and readable by any older coordinator); a metrics payload
+        # upgrades the file to one JSON object. last_heartbeat parses
+        # both, so fleets mix old and new workers freely. The timestamp
+        # is stamped INSIDE write(): a beat that lands only after retry
+        # backoffs must report when it landed, or the retry duration
+        # ages the worker toward eviction when the filesystem — not the
+        # worker — was slow.
         def write():
-            atomic_write_text(self._beat_path(worker_id), repr(time.time()),
+            body = (repr(time.time()) if metrics is None
+                    else json.dumps({"t": time.time(),
+                                     "metrics": dict(metrics)}))
+            atomic_write_text(self._beat_path(worker_id), body,
                               tmp_dir=os.path.join(self.root, "tmp"),
                               durable=False)
 
         self.retry_policy.call(write)
 
-    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+    def _read_beat(self, worker_id: str) -> Optional[Dict[str, Any]]:
         try:
             with open(self._beat_path(worker_id)) as f:
-                return float(f.read())
-        except (FileNotFoundError, ValueError):
+                raw = f.read()
+        except OSError:
             return None
+        try:
+            return {"t": float(raw), "metrics": None}
+        except ValueError:
+            pass
+        try:
+            d = json.loads(raw)
+            return {"t": float(d["t"]), "metrics": d.get("metrics")}
+        except (ValueError, TypeError, KeyError):
+            return None  # torn write on non-atomic media: treat as absent
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        beat = self._read_beat(worker_id)
+        return None if beat is None else beat["t"]
+
+    def heartbeat_metrics(self, worker_id: str
+                          ) -> Optional[Dict[str, Any]]:
+        beat = self._read_beat(worker_id)
+        return None if beat is None else beat["metrics"]
 
     def workers(self) -> List[str]:
         return sorted(os.listdir(os.path.join(self.root, "beats")))

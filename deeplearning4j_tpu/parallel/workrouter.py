@@ -21,12 +21,14 @@ instead (SURVEY §7.7a).
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor import metrics, record_counter, tracer
 from deeplearning4j_tpu.parallel.statetracker import StateTracker
 
 
@@ -171,7 +173,8 @@ class DistributedTrainer:
                  num_workers: int = 2, poll_s: float = 0.01,
                  max_attempts: int = 3, join_timeout_s: float = 60.0,
                  eviction_timeout_s: Optional[float] = None,
-                 heartbeat_interval_s: float = 1.0):
+                 heartbeat_interval_s: float = 1.0,
+                 straggler_ratio: float = 3.0):
         self.tracker = tracker
         self.router = router
         self.performer_factory = performer_factory
@@ -192,10 +195,19 @@ class DistributedTrainer:
                 f"single missed beat would evict a live worker")
         self.eviction_timeout_s = eviction_timeout_s
         self.heartbeat_interval_s = heartbeat_interval_s
+        # fleet view: a worker whose step time exceeds straggler_ratio x
+        # the fleet median gets flagged (>=3 reporting workers, so one
+        # slow pair can't nominate each other)
+        self.straggler_ratio = float(straggler_ratio)
         self.performers: List[WorkerPerformer] = []
         self.errors: List[str] = []
         self.evicted: List[str] = []
+        self.eviction_log: List[dict] = []  # decisions + their evidence
+        self.stragglers: set = set()
         self.monitors: Dict[str, Any] = {}
+        self._stats_lock = threading.Lock()
+        self._worker_stats: Dict[str, Dict[str, Any]] = {}
+        self._last_fleet_tick = 0.0
 
     def _worker_loop(self, worker_id: str, performer: WorkerPerformer,
                      stop: threading.Event) -> None:
@@ -205,9 +217,13 @@ class DistributedTrainer:
         # a long perform() (first-call XLA compile, a big job) must not go
         # silent and get spuriously evicted + double-executed. Only a dead
         # process — which takes its monitor thread with it — stops beating.
+        # Each beat carries the worker's compact metrics payload (step
+        # time, jobs, last loss, process goodput) for the master's fleet
+        # view; payload failures degrade to payload-less liveness.
         monitor = HeartbeatMonitor(
             self.tracker, worker_id,
-            interval_s=self.heartbeat_interval_s).start()
+            interval_s=self.heartbeat_interval_s,
+            payload_fn=lambda: self._heartbeat_payload(worker_id)).start()
         self.monitors[worker_id] = monitor
         try:
             self._worker_poll(worker_id, performer, stop)
@@ -225,7 +241,10 @@ class DistributedTrainer:
                 latest = self.router.current_params()
                 if latest is not None:
                     performer.update(latest)
+                t0 = time.monotonic()
                 update = performer.perform(job.payload)
+                self._note_step(worker_id, performer,
+                                time.monotonic() - t0)
                 self.router.post(worker_id, update)
                 self.tracker.complete_job(job.job_id)
             except Exception as e:
@@ -239,6 +258,118 @@ class DistributedTrainer:
                     f"{traceback.format_exc()}")
                 requeue = job.attempts < self.max_attempts
                 self.tracker.fail_job(job.job_id, requeue=requeue)
+
+    # -- fleet telemetry -------------------------------------------------
+    def _note_step(self, worker_id: str, performer: WorkerPerformer,
+                   step_s: float) -> None:
+        loss = None
+        score = getattr(getattr(performer, "network", None), "_score",
+                        None)
+        if score is not None:
+            try:
+                loss = float(score)  # control-plane thread, one scalar
+            except (TypeError, ValueError):
+                loss = None
+        with self._stats_lock:
+            prev = self._worker_stats.get(worker_id, {})
+            self._worker_stats[worker_id] = {
+                "step_s": float(step_s),
+                "jobs": int(prev.get("jobs", 0)) + 1,
+                "last_loss": loss,
+            }
+
+    def _heartbeat_payload(self, worker_id: str) -> Optional[dict]:
+        with self._stats_lock:
+            stats = self._worker_stats.get(worker_id)
+            payload = None if stats is None else dict(stats)
+        if payload is not None:
+            try:
+                from deeplearning4j_tpu.monitor.ledger import run_ledger
+
+                payload["goodput_pct"] = run_ledger().last_run_goodput()
+            except Exception:  # the beat must post regardless
+                pass
+        return payload
+
+    def fleet_tick(self) -> Dict[str, dict]:
+        """One master-side aggregation pass over the fleet's heartbeat
+        payloads: per-worker gauges (step time, goodput, last loss) land
+        in the registry, and step-time outliers — more than
+        ``straggler_ratio`` x the fleet median, with at least three
+        workers reporting — are flagged as stragglers, with the evidence
+        (step time, median, ratio) on the timeline. Returns the
+        per-worker payload map (tests read it)."""
+        fleet: Dict[str, dict] = {}
+        reg = metrics()
+        for w in self.tracker.workers():
+            m = self.tracker.heartbeat_metrics(w)
+            if not m:
+                continue
+            fleet[w] = m
+            if isinstance(m.get("step_s"), (int, float)):
+                reg.gauge("fleet_worker_step_seconds",
+                          "per-worker step time from heartbeat payloads"
+                          ).set(float(m["step_s"]), worker=w)
+            if isinstance(m.get("goodput_pct"), (int, float)):
+                reg.gauge("fleet_worker_goodput_pct",
+                          "per-worker run-ledger goodput"
+                          ).set(float(m["goodput_pct"]), worker=w)
+            if isinstance(m.get("last_loss"), (int, float)):
+                reg.gauge("fleet_worker_last_loss",
+                          "per-worker last-chunk loss"
+                          ).set(float(m["last_loss"]), worker=w)
+        steps = {w: float(m["step_s"]) for w, m in fleet.items()
+                 if isinstance(m.get("step_s"), (int, float))}
+        if len(steps) >= 3:
+            median = statistics.median(steps.values())
+            for w, s in steps.items():
+                slow = median > 0 and s > self.straggler_ratio * median
+                if slow and w not in self.stragglers:
+                    self.stragglers.add(w)
+                    record_counter("fleet_stragglers_total", worker=w)
+                    tracer().event("fleet.straggler", worker=w,
+                                   step_s=round(s, 4),
+                                   median_s=round(median, 4),
+                                   ratio=self.straggler_ratio)
+                elif not slow:
+                    self.stragglers.discard(w)
+        reg.gauge("fleet_workers", "workers with live heartbeats"
+                  ).set(float(len(self.tracker.workers())))
+        reg.gauge("fleet_stragglers",
+                  "workers currently flagged as stragglers"
+                  ).set(float(len(self.stragglers)))
+        return fleet
+
+    def _evict_tick(self) -> List[str]:
+        """Evict stale workers AND record each decision with the
+        evidence that justified it — beat age vs timeout plus the last
+        metrics payload the dead worker reported — so a postmortem can
+        audit why the master dropped someone. Evidence (a second beat
+        read + a metrics read) is gathered ONLY for workers already
+        past the timeout: the common all-alive tick costs the same one
+        read per worker it always did."""
+        now = time.time()
+        evidence = {}
+        for w in self.tracker.workers():
+            t = self.tracker.last_heartbeat(w)
+            if t is not None and now - t < self.eviction_timeout_s:
+                continue  # alive: no evidence needed, no extra I/O
+            evidence[w] = {
+                "silent_s": None if t is None else round(now - t, 3),
+                "last_metrics": self.tracker.heartbeat_metrics(w),
+            }
+        stale = self.tracker.evict_stale(self.eviction_timeout_s)
+        for w in stale:
+            decision = {"worker": w,
+                        "timeout_s": self.eviction_timeout_s,
+                        "t_wall": now, **evidence.get(w, {})}
+            self.eviction_log.append(decision)
+            record_counter("fleet_evictions_total", worker=w)
+            # the tracer event forwards into the flight ring on its own
+            # (trace._record) — no explicit flight write, or evictions
+            # would double-count in the postmortem tally
+            tracer().event("fleet.evict", **decision)
+        return stale
 
     def train(self, timeout_s: float = 120.0,
               raise_on_failed_jobs: bool = True) -> np.ndarray:
@@ -258,8 +389,16 @@ class DistributedTrainer:
         try:
             while time.monotonic() < deadline:
                 self.router.step(self.num_workers)
+                # fleet aggregation is throttled to the beat cadence —
+                # re-reading every payload each 10 ms poll would hammer a
+                # file-backed tracker for data that changes once a beat
+                now_mono = time.monotonic()
+                if now_mono - self._last_fleet_tick >= max(
+                        self.poll_s, self.heartbeat_interval_s):
+                    self._last_fleet_tick = now_mono
+                    self.fleet_tick()
                 if self.eviction_timeout_s is not None:
-                    stale = self.tracker.evict_stale(self.eviction_timeout_s)
+                    stale = self._evict_tick()
                     if stale:
                         self.evicted.extend(stale)
                         self.errors.append(

@@ -548,6 +548,12 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.monitor import record_counter, tracer
+    from deeplearning4j_tpu.monitor.ledger import (
+        ledger_chunk_done,
+        ledger_chunk_start,
+        ledger_run_end,
+        ledger_run_start,
+    )
     from deeplearning4j_tpu.resilience import faults
     from deeplearning4j_tpu.resilience.watchdog import StepWatchdog
 
@@ -571,9 +577,18 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     # path (device arrays accumulate; one sync at end of run)
     defer_inspect = guard not in ("halve_lr", "raise")
     done = 0
+    stopped = False
+    run_error = None
     watchdog = StepWatchdog(
         chunk_deadline_s(chunk_epochs * cache.n_batches))
     net._chunk_watchdog = watchdog  # introspection (tests, metrics)
+    # the run-ledger window opens here and closes in the finally below:
+    # the ledger (and the flight recorder, when DL4J_FLIGHT is on) only
+    # ever hears from this driver at chunk boundaries — never from
+    # inside a traced program (dl4j-lint's host-sync rule enforces it)
+    ledger_run_start(model=model_name, epochs=num_epochs,
+                     steps=num_epochs * cache.n_batches,
+                     chunk_epochs=chunk_epochs, guard=guard)
     try:
         with watchdog:
             while done < num_epochs:
@@ -593,12 +608,16 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                 # the span times the HOST-side dispatch (the XLA launch
                 # returns before the chunk completes; completion shows up
                 # in the next blocking read's epoch.readback span)
+                ledger_chunk_start(model=model_name, epoch0=done,
+                                   epochs=k)
                 with tracer().span("epoch.chunk", model=model_name,
                                    epochs=k,
                                    steps=k * cache.n_batches,
                                    epoch0=done):
                     hist, trips, mets = launch_chunk(keys[1:])
                 watchdog.beat()
+                ledger_chunk_done(model=model_name, epoch0=done,
+                                  epochs=k)
                 net._train_dispatches += 1
                 record_counter("train_chunk_dispatches_total",
                                model=model_name)
@@ -637,7 +656,11 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                     else:  # pre-telemetry listener protocol
                         listener.iteration_done(net, net.iteration_count)
                 if on_chunk is not None and on_chunk(done):
+                    stopped = True
                     break
+    except BaseException as e:
+        run_error = e
+        raise
     finally:
         # flush even when the raise policy aborts the run mid-chunk: a
         # TrainingDivergedError handler reads the history that tripped it
@@ -655,6 +678,14 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                 # absolute: the history covers the run from epoch 0)
                 _enforce_nan_guard(net, guard, full, 0, None, shuffle,
                                    cache.n_batches, None, 0, None)
+        # close the ledger window LAST so the sentinel flush above is
+        # still inside the run it belongs to; the status string is what
+        # flight_report classifies a dead run's sibling from
+        ledger_run_end(
+            status=(f"error:{type(run_error).__name__}"
+                    if run_error is not None
+                    else ("stopped" if stopped else "clean")),
+            model=model_name, epochs_done=done)
     return history[0] if len(history) == 1 else jnp.concatenate(history)
 
 

@@ -69,6 +69,7 @@ TRACKED = [
 OVERHEADS = [
     ("guard_overhead_pct", ("guard", "sentinel_overhead_pct")),
     ("telemetry_overhead_pct", ("telemetry", "pack_overhead_pct")),
+    ("flight_overhead_pct", ("flight", "flight_overhead_pct")),
 ]
 
 
@@ -78,6 +79,31 @@ def _dig(row: dict, section: str, field: str):
         return None
     val = sec.get(field)
     return float(val) if isinstance(val, (int, float)) else None
+
+
+def _dig_ledger(row: dict, field: str = "goodput_pct"):
+    """Run-ledger fields from the artifact's telemetry block (PR 9):
+    ``extras.telemetry.ledger.{goodput_pct, badput, ...}``. Absent on
+    pre-ledger rounds — the column just shows '-'."""
+    tel = (row.get("extras") or {}).get("telemetry")
+    if not isinstance(tel, dict):
+        return None
+    ledger = tel.get("ledger")
+    if not isinstance(ledger, dict):
+        return None
+    val = ledger.get(field)
+    if field == "badput" and isinstance(val, dict):
+        return val
+    return float(val) if isinstance(val, (int, float)) else None
+
+
+def _badput_note(row: dict):
+    """Compact 'state=seconds' summary of the ledger's badput."""
+    bad = _dig_ledger(row, "badput")
+    if not bad:
+        return None
+    return ",".join(f"{k}={v:.1f}s"
+                    for k, v in sorted(bad.items(), key=lambda kv: -kv[1]))
 
 
 def _round_number(path: str, payload: dict) -> Optional[int]:
@@ -185,7 +211,8 @@ def _fmt(v) -> str:
 def print_table(rows: List[dict], out=None) -> None:
     out = out or sys.stdout
     cols = ["round", "status", "headline", "value", "tf_mfu%",
-            "rn_mfu%", "guard_ov%", "telem_ov%", "note"]
+            "rn_mfu%", "guard_ov%", "telem_ov%", "goodput%", "badput",
+            "note"]
     table = []
     for row in rows:
         table.append([
@@ -197,6 +224,8 @@ def print_table(rows: List[dict], out=None) -> None:
             _fmt(_dig(row, "resnet18_cifar10", "mfu_pct")),
             _fmt(_dig(row, *OVERHEADS[0][1])),
             _fmt(_dig(row, *OVERHEADS[1][1])),
+            _fmt(_dig_ledger(row)),
+            _badput_note(row) or "-",
             row["note"],
         ])
     widths = [max(len(str(r[i])) for r in [cols] + table)
@@ -214,6 +243,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="exit 1 when a tracked series regresses")
     ap.add_argument("--threshold-pct", type=float, default=20.0,
                     help="regression threshold (default 20%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (compact per-round "
+                         "rows + series + regressions) instead of the "
+                         "table")
     args = ap.parse_args(argv)
 
     rows = []
@@ -226,6 +259,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
     rows.sort(key=lambda r: (r["round"] is None, r["round"]))
 
+    series = build_series(rows)
+    regressions = find_regressions(series, args.threshold_pct)
+
+    if args.json:
+        # compact rows (extras are megabytes in real artifacts — keep
+        # the machine-readable shape to the scored/reported fields)
+        compact = []
+        for row in rows:
+            entry = {
+                "round": row["round"], "status": row["status"],
+                "metric": row["metric"], "value": row["value"],
+                "unit": row["unit"], "rc": row["rc"],
+                "note": row["note"],
+                "goodput_pct": _dig_ledger(row),
+                "badput": _dig_ledger(row, "badput"),
+            }
+            for label, extract in TRACKED[1:]:
+                entry[label] = extract(row)
+            for label, keys in OVERHEADS:
+                entry[label] = _dig(row, *keys)
+            compact.append(entry)
+        print(json.dumps({
+            "rounds": compact,
+            "series": {k: v for k, v in sorted(series.items())},
+            "threshold_pct": args.threshold_pct,
+            "regressions": regressions,
+        }))
+        return 1 if (regressions and args.check) else 0
+
     print_table(rows)
     bad = [r for r in rows if r["status"] != "ok"]
     if bad:
@@ -237,8 +299,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{row['status'].upper()} round — excluded from "
                   f"regression scoring: {row['note']}")
 
-    regressions = find_regressions(build_series(rows),
-                                   args.threshold_pct)
     if regressions:
         print("\nREGRESSIONS:")
         for r in regressions:

@@ -20,10 +20,14 @@
 #                                      policies, preemption + bitwise
 #                                      elastic resume, save_async,
 #                                      checkpoint corruption/eviction)
-#        scripts/verify.sh --obs      (just the telemetry suite — metrics
-#                                      pack parity/values, registry,
-#                                      tracer, exporters — plus the
-#                                      no-bare-counters lint rule)
+#        scripts/verify.sh --obs      (just the observability suites —
+#                                      metrics pack parity/values,
+#                                      registry, tracer, exporters, run
+#                                      ledger, flight recorder, fleet
+#                                      heartbeats — plus the
+#                                      no-bare-counters lint rule and the
+#                                      flight-recorder write → kill -9 →
+#                                      report round trip)
 #        scripts/verify.sh --lint     (static analysis gate: the full
 #                                      dl4j-lint ruleset over the tree +
 #                                      the program-contract checks and
@@ -58,11 +62,15 @@ elif [ "${1:-}" = "--heal" ]; then
     TARGET="tests/test_self_healing.py tests/test_resilience.py tests/test_cluster.py"
 elif [ "${1:-}" = "--obs" ]; then
     shift
-    TARGET=tests/test_telemetry.py
+    TARGET="tests/test_telemetry.py tests/test_flight.py"
     # the counters lint rides along with the telemetry suite: no module
     # besides monitor/ may define new bare _*_counter attributes
     # (the old scripts/lint_telemetry.py, absorbed into dl4j-lint)
     python scripts/dl4j_lint.py --select bare-counter || exit 1
+    # crash-forensics gate: a flight-recorder child is written to, kill
+    # -9'd mid-chunk, and the surviving segments must reconstruct the
+    # timeline and classify the death as 'crashed'
+    python scripts/flight_report.py --selftest || exit 1
 elif [ "${1:-}" = "--lint" ]; then
     shift
     # static-analysis gate: source-level ruleset first (stdlib-only,

@@ -44,6 +44,10 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
     classify "clean"); grant acquisition drops open "grant.wait"
     markers into the recorder so a wedged grant is classifiable from
     the surviving segments alone (scripts/flight_report.py)
+  - serve: the continuous-batching decode server under an open-loop
+    Poisson stream — p50/p99 latency, TTFT/TPOT, tokens/sec, slot
+    occupancy, and compile-count flatness after warmup (plus the
+    persisted XLA compilation cache's on-disk stats)
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs come from the COMPILED program's ``cost_analysis()`` when the
@@ -826,6 +830,107 @@ def bench_flight():
             "batch": batch, "n_batches": n_batches, "epochs": epochs}
 
 
+def bench_serve():
+    """Online serving path: the continuous-batching decode server under
+    an open-loop Poisson request stream (ragged prompt/generation
+    lengths). Reports p50/p99 request latency, TTFT/TPOT, tokens/sec,
+    occupancy, and the compile-flatness evidence: program builds during
+    the warmup stream vs after a second ragged stream — the steady-state
+    count MUST stay flat (one decode program + one prefill per ladder
+    rung, never a compile per request shape). Also reports the persisted
+    XLA compilation cache (DL4J_COMPILE_CACHE_DIR — scoped to a
+    section-local temp dir when the caller set none, so cold-start
+    replay is exercised without leaking cache config or disk into the
+    other sections) entry counts, so a fleet replica's warm boot is
+    checkable from the artifact."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.serving import compile_cache as _cc
+
+    # respect a caller-provided cache dir; otherwise stand up a
+    # section-scoped one and tear the whole configuration back down in
+    # the finally (later sections must not inherit persist-everything
+    # compile caching, and the bench must not orphan temp dirs)
+    tmp = None
+    prev_knobs = {}
+    if not os.environ.get("DL4J_COMPILE_CACHE_DIR", "").strip():
+        tmp = tempfile.mkdtemp(prefix="dl4j-compile-cache-")
+        os.environ["DL4J_COMPILE_CACHE_DIR"] = tmp
+        for knob in ("jax_compilation_cache_dir",
+                     "jax_persistent_cache_min_compile_time_secs",
+                     "jax_persistent_cache_min_entry_size_bytes"):
+            try:
+                prev_knobs[knob] = getattr(jax.config, knob)
+            except AttributeError:
+                pass
+    try:
+        return _bench_serve_run()
+    finally:
+        if tmp is not None:
+            os.environ.pop("DL4J_COMPILE_CACHE_DIR", None)
+            for knob, val in prev_knobs.items():
+                try:
+                    jax.config.update(knob, val)
+                except Exception:
+                    pass
+            _cc._reset_for_tests()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_serve_run():
+    from deeplearning4j_tpu.models.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import (
+        DecodeServer, compile_cache_stats, poisson_schedule,
+        run_open_loop)
+
+    lm = TransformerLM(vocab_size=512, d_model=128, num_heads=8,
+                       num_kv_heads=4, num_layers=2, max_len=512,
+                       seed=7, dtype_policy="bf16",
+                       pos_encoding="rope").init()
+    slots = 8
+    server = DecodeServer(lm, slots=slots, max_len=256)
+
+    # warmup stream: cold compiles (decode + every ladder rung the
+    # request mix touches) land here
+    warm_sched = poisson_schedule(
+        16, rate_rps=200.0, vocab_size=512,
+        prompt_lens=(8, 16, 24, 48), max_new_tokens=(8, 16), seed=1)
+    run_open_loop(server, warm_sched)
+    builds_warm = server.engine.program_builds
+    compiles_warm = dict(server.stats()["compiles"])
+
+    # measured stream: same shape menu, 4x the requests — zero new
+    # programs may appear
+    sched = poisson_schedule(
+        64, rate_rps=200.0, vocab_size=512,
+        prompt_lens=(8, 16, 24, 48), max_new_tokens=(8, 16), seed=2)
+    report = run_open_loop(server, sched)
+    builds_steady = server.engine.program_builds
+    flat = builds_steady == builds_warm
+
+    summary = report.summary()
+    stats = server.stats()
+    _log(f"serve: {summary['tokens_per_sec']:,.0f} tokens/sec, "
+         f"p50 {summary['p50_latency_ms']} ms / "
+         f"p99 {summary['p99_latency_ms']} ms, TTFT p50 "
+         f"{summary['ttft_p50_ms']} ms, occupancy "
+         f"{summary['occupancy_mean']}; compiles warm={builds_warm} "
+         f"steady={builds_steady} "
+         f"({'FLAT' if flat else 'NOT FLAT — recompiling per request?'})")
+    return {**summary,
+            "slots": slots,
+            "kv_pool_bytes": stats["kv_pool_bytes"],
+            "compiles_after_warmup": compiles_warm,
+            "program_builds_warmup": builds_warm,
+            "program_builds_steady": builds_steady,
+            "compile_count_flat_after_warmup": bool(flat),
+            "compile_cache": compile_cache_stats()}
+
+
 def bench_eval():
     """Inference/eval path: device-resident confusion accumulation vs the
     host path (per-batch logit readback) on a stream of ragged batches.
@@ -1305,6 +1410,7 @@ def main() -> None:
                 ("eval", bench_eval),
                 ("epoch", bench_epoch),
                 ("dp_epoch", bench_dp_epoch),
+                ("serve", bench_serve),
                 ("guard", bench_guard),
                 ("telemetry", bench_telemetry),
                 ("flight", bench_flight)]

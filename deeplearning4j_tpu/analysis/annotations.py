@@ -73,4 +73,10 @@ HOT_PATH_REGISTRY = frozenset({
     "step_metrics",
     "tree_global_norm",
     "tree_all_finite",
+    # serving/engine.py — the decode server's jitted program bodies (a
+    # host sync here would serialize every online token behind a device
+    # readback; the serve loop's ONE sanctioned readback lives in
+    # serving/server.py, outside these roots)
+    "_serve_prefill_impl",
+    "_serve_decode_impl",
 })

@@ -44,15 +44,19 @@ logger = logging.getLogger(__name__)
 
 def _rope(x, positions, base: float = 10000.0):
     """Rotary position embedding on [b, t, h, d] at absolute ``positions``
-    [t] (may be traced). Angles in f32, result in x's dtype. Rotation is
+    (may be traced): [t] shared across the batch (training/prefill), or
+    [b, t] per-row (the serving decode step, where every slot sits at its
+    own position). Angles in f32, result in x's dtype. Rotation is
     applied to q/k BEFORE attention, so it composes unchanged with the
     XLA, Pallas-flash, and ring paths."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    if positions.ndim == 1:       # [t, half] -> broadcast over batch
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate(
@@ -203,8 +207,9 @@ class TransformerLM:
         ``pos_encoding="rope"``). ``attention(q, k, v) -> o`` overrides
         the causal self-attention core (the KV-cache decode attends
         against the cache instead) while sharing every other line of
-        block math. ``positions`` [t] are the absolute positions for
-        RoPE (default 0..t-1; the decode step passes its cache slot)."""
+        block math. ``positions`` are the absolute positions for RoPE —
+        [t] (default 0..t-1; the decode step passes its cache slot) or
+        [b, t] per-row (the serving decode, one position per slot)."""
         policy = self.policy
         b, t = h.shape[0], h.shape[1]
         x = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])

@@ -14,7 +14,10 @@ label mask is created-or-extended with zeros so pad rows contribute nothing
 to any mask-weighted reduction (loss, confusion counts, regression sums).
 The time axis of RNN batches is NOT bucketed — bidirectional layers read
 future timesteps, so time padding is not inert there; time raggedness
-should be handled upstream (fixed-length windows / TBPTT).
+should be handled upstream (fixed-length windows / TBPTT). The one
+sanctioned exception is the serving prefill's PROMPT axis (causal
+decoder, pad tail causally unreachable): ``prompt_bucket``/``pad_prompt``
+below, consumed only by ``deeplearning4j_tpu/serving/``.
 """
 
 from __future__ import annotations
@@ -79,6 +82,56 @@ def padded_label_mask(labels, labels_mask, target: int):
     else:
         labels_mask = jnp.asarray(labels_mask, jnp.float32)
     return pad_axis0(labels_mask, target)
+
+
+# ---------------------------------------------------------------------------
+# Prompt-length ladder (serving only).
+#
+# The "time axis is never bucketed" rule above is about TRAINING/EVAL
+# batches: bidirectional layers read future timesteps, so time padding is
+# not inert there. A causal decoder prefill is different — position i
+# attends keys 0..i only, so tokens appended PAST the prompt can never
+# influence the real positions, and the serving layer pads every prompt up
+# a powers-of-two ladder to bound prefill compiles the same way the batch
+# axis is bounded. Decode masks keys strictly beyond the write cursor, so
+# the pad tail in the KV pool is never attended either (mask correctness
+# is asserted in tests/test_serving.py).
+DEFAULT_PROMPT_BUCKETS: Tuple[int, ...] = (
+    16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def prompt_bucket(n: int, buckets: Optional[Sequence[int]] = None,
+                  max_len: Optional[int] = None) -> int:
+    """Smallest prompt-ladder rung >= ``n`` for the serving prefill.
+
+    ``max_len`` (the server's slot capacity T_max) caps the rung — a
+    prompt longer than every rung below the cap pads only to ``max_len``
+    (never past the KV pool). ``DL4J_DISABLE_BUCKETING=1`` makes every
+    prompt exact, the same escape hatch as the batch ladder."""
+    if n <= 0:
+        raise ValueError(f"prompt length must be >= 1 (got {n})")
+    if max_len is not None and n > max_len:
+        raise ValueError(f"prompt length {n} exceeds max_len={max_len}")
+    if not bucketing_enabled():
+        return n
+    b = bucket_size(n, buckets or DEFAULT_PROMPT_BUCKETS)
+    return b if max_len is None else min(b, max_len)
+
+
+def pad_prompt(tokens, bucket: int, pad_id: int = 0):
+    """Right-pad token rows ([t] or [b, t] int) to ``bucket`` positions.
+
+    Returns ``(padded, length)`` with ``length`` the real prompt length
+    — the prefill reads its last hidden state from ``length - 1`` and
+    starts the slot's write cursor there, so the pad tail is causally
+    unreachable (pad tokens sit at positions the decode mask excludes
+    until they are overwritten by generated tokens)."""
+    a = np.asarray(tokens)
+    t = int(a.shape[-1])
+    if t > bucket:
+        raise ValueError(f"prompt length {t} exceeds bucket {bucket}")
+    widths = [(0, 0)] * (a.ndim - 1) + [(0, bucket - t)]
+    return np.pad(a, widths, constant_values=pad_id), t
 
 
 def pad_dataset(ds, buckets: Optional[Sequence[int]] = None):

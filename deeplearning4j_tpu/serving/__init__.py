@@ -1,0 +1,59 @@
+"""Online serving subsystem: continuous batching + batched KV-cache decode.
+
+Every inference surface before this one was batch/offline-oriented (PR
+2's shape-bucketed, device-resident eval). This package is the ONLINE
+path the north star's "heavy traffic" needs — the TensorFlow-paper
+serving/training split applied to the fused-program framework:
+
+- :mod:`~deeplearning4j_tpu.serving.kv_cache` — the slot-based batched
+  KV pool: ``[L, S, T_max, Hkv, Dh]`` device-resident K/V with per-slot
+  write cursors, so S concurrent requests at different decode positions
+  are ONE program's batch dimension.
+- :mod:`~deeplearning4j_tpu.serving.engine` — the two jitted programs
+  (bucket-padded prefill, batched decode step) built on the SAME
+  ``TransformerLM._block`` math as training; ``@traced`` hot roots for
+  dl4j-lint's host-sync rule.
+- :mod:`~deeplearning4j_tpu.serving.scheduler` — request model + bounded
+  FIFO admission queue (``DL4J_SERVE_SLOTS``/``DL4J_SERVE_MAX_QUEUE``).
+- :mod:`~deeplearning4j_tpu.serving.server` — :class:`DecodeServer`,
+  the continuous-batching loop: admit into free slots at step
+  boundaries, one batched decode step, retire finished sequences; never
+  recompiles past one program per (slot-count, prefill-bucket).
+- :mod:`~deeplearning4j_tpu.serving.compile_cache` — persisted XLA
+  compilation cache (``DL4J_COMPILE_CACHE_DIR``) so fleet cold-start
+  replays compiles from disk.
+- :mod:`~deeplearning4j_tpu.serving.loadgen` — open-loop Poisson load
+  generator + p50/p99/TTFT/TPOT report (the ``serve`` bench section).
+
+See ``docs/inference.md`` §Serving for the architecture and the slot
+lifecycle, ``docs/observability.md`` for the serve metric/span taxonomy.
+"""
+
+from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
+    compile_cache_dir,
+    compile_cache_stats,
+    ensure_compile_cache,
+)
+from deeplearning4j_tpu.serving.kv_cache import SlotKVCache  # noqa: F401
+from deeplearning4j_tpu.serving.engine import DecodeEngine  # noqa: F401
+from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
+    RequestQueue,
+    ServeQueueFull,
+    ServeRequest,
+    serve_max_queue,
+    serve_slots,
+)
+from deeplearning4j_tpu.serving.server import DecodeServer  # noqa: F401
+from deeplearning4j_tpu.serving.loadgen import (  # noqa: F401
+    Arrival,
+    LoadReport,
+    poisson_schedule,
+    run_open_loop,
+)
+
+__all__ = [
+    "Arrival", "DecodeEngine", "DecodeServer", "LoadReport",
+    "RequestQueue", "ServeQueueFull", "ServeRequest", "SlotKVCache",
+    "compile_cache_dir", "compile_cache_stats", "ensure_compile_cache",
+    "poisson_schedule", "run_open_loop", "serve_max_queue", "serve_slots",
+]

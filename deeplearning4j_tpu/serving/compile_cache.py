@@ -1,0 +1,101 @@
+"""Persisted XLA compilation cache for the serving fleet's cold start.
+
+A decode server amortizes compilation over a process lifetime, but a
+FLEET amortizes it over deployments: every replica that boots compiles
+the same (slot-count, prefill-bucket) program set from scratch unless the
+compiled artifacts persist. ``DL4J_COMPILE_CACHE_DIR`` points jax's
+persistent compilation cache at a shared directory so a cold replica
+replays compiles from disk instead of paying XLA again (the
+serving/training split of the TensorFlow paper: the server process is
+long-lived state, and here even its *programs* outlive the process).
+
+Configuration is LAZY — ``ensure_compile_cache()`` runs before the
+serving layer's first compile, never at import (jax must not be dragged
+in by control-plane imports, and the env must be readable right up to
+first use). Idempotent; re-pointing at a new directory reconfigures.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["compile_cache_dir", "ensure_compile_cache",
+           "compile_cache_stats"]
+
+_LOCK = threading.Lock()
+_CONFIGURED_DIR: Optional[str] = None
+
+
+def compile_cache_dir() -> Optional[str]:
+    """``DL4J_COMPILE_CACHE_DIR``: directory for jax's persistent
+    compilation cache (unset = no persistence, in-process caching only)."""
+    raw = os.environ.get("DL4J_COMPILE_CACHE_DIR", "").strip()
+    return raw or None
+
+
+def ensure_compile_cache() -> Optional[str]:
+    """Point ``jax_compilation_cache_dir`` at ``DL4J_COMPILE_CACHE_DIR``
+    if set, before the caller's first compile. Returns the configured
+    directory (or None when the env is unset / the jax build lacks the
+    knob). Every compile is persisted (min-compile-time and min-entry-
+    size floors zeroed): serving cold-start wants the whole program set
+    replayed, not just the slow members."""
+    global _CONFIGURED_DIR
+    d = compile_cache_dir()
+    if d is None:
+        return None
+    with _LOCK:
+        if _CONFIGURED_DIR == d:
+            return d
+        os.makedirs(d, exist_ok=True)
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+        except Exception as e:  # older jax without the persistent cache
+            logger.warning("DL4J_COMPILE_CACHE_DIR=%s ignored: this jax "
+                           "has no jax_compilation_cache_dir (%s)", d, e)
+            return None
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                          ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # knob spelling varies across jax versions
+                pass
+        _CONFIGURED_DIR = d
+        from deeplearning4j_tpu.monitor import record_counter, tracer
+
+        tracer().event("serve.compile_cache", dir=d)
+        record_counter("serve_compile_cache_configured_total")
+        logger.info("persistent XLA compilation cache at %s", d)
+        return d
+
+
+def compile_cache_stats() -> dict:
+    """On-disk view of the persistent cache: ``{dir, configured,
+    entries, bytes}`` — what a bench artifact reports so warm-start
+    claims are checkable."""
+    d = compile_cache_dir()
+    entries = 0
+    size = 0
+    if d and os.path.isdir(d):
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                entries += 1
+                try:
+                    size += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+    return {"dir": d, "configured": _CONFIGURED_DIR == d and d is not None,
+            "entries": entries, "bytes": size}
+
+
+def _reset_for_tests() -> None:
+    global _CONFIGURED_DIR
+    with _LOCK:
+        _CONFIGURED_DIR = None

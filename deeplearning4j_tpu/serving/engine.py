@@ -1,0 +1,258 @@
+"""Batched decode engine: the jitted programs behind the decode server.
+
+Two programs serve any request stream, and the engine never compiles a
+third:
+
+- ``("prefill", P_bucket)`` — one bucket-padded prompt forward ([1, P])
+  through the SAME ``TransformerLM._block`` math as training, writing the
+  per-layer K/V into one slot of the ``[L, S, T_max, Hkv, Dh]`` pool and
+  sampling the request's first token from position ``prompt_len - 1``.
+  One compile per prompt-ladder rung (``perf/bucketing.prompt_bucket``).
+- ``("decode", S)`` — ONE step for ALL S slots at their own positions:
+  scatter the consumed tokens' K/V at each slot's cursor, attend each row
+  against its own masked cache history (GQA-aware — the pool stores
+  ``num_kv_heads``), sample one token per row from per-slot RNG streams.
+  One compile per slot count, i.e. one for the server's lifetime.
+
+Both are ``@traced`` hot roots (``analysis/annotations.HOT_PATH_REGISTRY``)
+so dl4j-lint's host-sync rule guards the decode loop: a ``float()`` /
+``np.asarray`` slipped into this module's program bodies is a lint
+finding, not a silent per-token device sync.
+
+Numerics contract (tests/test_serving.py): a slot's token sequence is
+IDENTICAL to ``TransformerLM.generate`` on the same prompt — greedy and
+sampled (each slot replays the exact ``sample``/``split`` chain of a
+single-request ``generate(seed=...)``). Slot rows are computationally
+independent (every op is row-wise; masked pad keys contribute exactly
+zero attention weight), so batching requests changes no request's tokens.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.analysis.annotations import traced
+from deeplearning4j_tpu.perf.bucketing import (
+    DEFAULT_PROMPT_BUCKETS, pad_prompt, prompt_bucket)
+from deeplearning4j_tpu.serving.compile_cache import ensure_compile_cache
+from deeplearning4j_tpu.serving.kv_cache import SlotKVCache
+
+__all__ = ["DecodeEngine"]
+
+
+def _row_sampler(temperature: float, top_k: Optional[int]):
+    """Per-row sampler ``(logits [V], key [2]) -> (tok, key)`` replaying
+    the exact op sequence of ``make_generate``'s batch-of-one ``sample``
+    (logits lifted to [1, V] so the categorical draw consumes the same
+    random bits a single-request decode would)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def one(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits[None], axis=-1)[0].astype(jnp.int32), \
+                key
+        scaled = logits[None] / temperature
+        if top_k is not None:
+            kth = lax.top_k(scaled, top_k)[0][:, -1]
+            scaled = jnp.where(scaled >= kth[:, None], scaled, -jnp.inf)
+        key, sub = jax.random.split(key)
+        return jax.random.categorical(sub, scaled, axis=-1)[0].astype(
+            jnp.int32), key
+
+    return one
+
+
+@traced
+def _serve_prefill_impl(model, sample_row, params, pool_k, pool_v,
+                        prompt, prompt_len, slot, key):
+    """Prefill one bucket-padded prompt ([1, P]) into pool slot ``slot``.
+
+    Causality makes the pad tail inert: position ``i < prompt_len``
+    attends keys ``0..i`` — all real tokens — so the K/V written at real
+    positions (and the ``prompt_len - 1`` hidden state the first token is
+    sampled from) are the unpadded prefill's values. ``prompt_len`` and
+    ``slot`` are traced: one compile per bucket, not per request."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    policy = model.policy
+    cdt = policy.compute_dtype
+    p = prompt.shape[1]
+    h = jnp.take(params["embed"], prompt, axis=0)
+    if model.pos_encoding == "learned":
+        h = h + params["pos"][:p][None]
+    h = policy.cast_compute(h)
+    ks, vs = [], []
+    for blk in params["blocks"]:
+        h, kk, vv = model._block(blk, h)
+        ks.append(kk.astype(cdt))
+        vs.append(vv.astype(cdt))
+    # [L, 1, P, Hkv, Dh] written at (layer 0, slot, position 0)
+    pool_k = lax.dynamic_update_slice(
+        pool_k, jnp.stack(ks), (0, slot, 0, 0, 0))
+    pool_v = lax.dynamic_update_slice(
+        pool_v, jnp.stack(vs), (0, slot, 0, 0, 0))
+    h_last = jnp.take(h[0], prompt_len - 1, axis=0)        # [D]
+    tok, key = sample_row(model._unembed(params, h_last), key)
+    return tok, key, pool_k, pool_v
+
+
+@traced
+def _serve_decode_impl(model, sample_row, params, pool_k, pool_v,
+                       tok, positions, keys):
+    """ONE decode step for all S slots: consume ``tok[s]`` at
+    ``positions[s]``, write its K/V at that cursor, attend keys
+    ``<= positions[s]`` (window-clipped like training), emit the next
+    token per slot from its own RNG stream. Free slots ride along
+    computing garbage no one reads — their rows are masked out of
+    nothing (rows are independent) and their pool writes land at frozen
+    cursors the admission prefill overwrites."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.attention import grouped_query_attention
+
+    policy = model.policy
+    cdt = policy.compute_dtype
+    s = tok.shape[0]
+    t_max = pool_k.shape[2]
+    h = jnp.take(params["embed"], tok, axis=0)             # [S, D]
+    if model.pos_encoding == "learned":
+        h = h + params["pos"][positions]
+    h = policy.cast_compute(h)[:, None, :]                 # [S, 1, D]
+    live = jnp.arange(t_max)[None, :] <= positions[:, None]
+    if model.attn_window is not None:
+        live &= (jnp.arange(t_max)[None, :]
+                 > positions[:, None] - model.attn_window)
+    new_k, new_v = [], []
+    rows = jnp.arange(s)
+
+    def cached_attention(li):
+        def attn(q, kk, vv):
+            ck = pool_k[li].at[rows, positions].set(kk[:, 0].astype(cdt))
+            cv = pool_v[li].at[rows, positions].set(vv[:, 0].astype(cdt))
+            new_k.append(ck)
+            new_v.append(cv)
+            return grouped_query_attention(q, ck, cv, mask=live)
+        return attn
+
+    for li, blk in enumerate(params["blocks"]):
+        h, _, _ = model._block(blk, h, attention=cached_attention(li),
+                               positions=positions[:, None])
+    logits = model._unembed(params, h[:, 0])               # [S, V]
+    toks, keys = jax.vmap(sample_row)(logits, keys)
+    return toks, keys, jnp.stack(new_k), jnp.stack(new_v)
+
+
+class DecodeEngine:
+    """Owns the slot pool + the per-signature program cache.
+
+    ``temperature``/``top_k`` are server-level (baked into the compiled
+    programs — a per-request sampling config would be a program
+    signature per config, exactly the recompile hazard the server
+    exists to avoid); per-request randomness rides in per-slot keys.
+    """
+
+    def __init__(self, model, slots: int, *,
+                 max_len: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None):
+        if temperature < 0.0:
+            raise ValueError(f"temperature={temperature} must be >= 0")
+        if top_k is not None and not 1 <= top_k <= model.vocab_size:
+            raise ValueError(
+                f"top_k={top_k} must be in [1, vocab={model.vocab_size}]")
+        model._ensure_init()
+        self.model = model
+        self.cache = SlotKVCache(model, slots, max_len)
+        self.slots = self.cache.slots
+        self.max_len = self.cache.max_len
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.buckets = tuple(b for b in (buckets or DEFAULT_PROMPT_BUCKETS)
+                             if b <= self.max_len) or (self.max_len,)
+        self._sample_row = _row_sampler(self.temperature, top_k)
+        self._programs: Dict[tuple, object] = {}
+        self.program_builds = 0
+        # the fleet story: point jax's persistent compilation cache at
+        # DL4J_COMPILE_CACHE_DIR before this engine's first compile
+        ensure_compile_cache()
+
+    # ------------------------------------------------------------------
+    def _program(self, sig: tuple, factory):
+        """One jitted program per signature for the engine's lifetime —
+        the build count IS the compile count (fixed shapes per
+        signature), mirrored into the registry so the bench and the
+        soak test can assert flatness after warmup."""
+        fn = self._programs.get(sig)
+        if fn is None:
+            from deeplearning4j_tpu.monitor import record_counter
+
+            fn = self._programs[sig] = factory()
+            self.program_builds += 1
+            record_counter("serve_program_builds_total", kind=sig[0])
+        return fn
+
+    def compile_counts(self) -> dict:
+        """``{decode, prefill_buckets, total}`` — the warmup-flatness
+        evidence serving artifacts embed."""
+        pre = sorted(s[1] for s in self._programs if s[0] == "prefill")
+        return {"decode": sum(1 for s in self._programs
+                              if s[0] == "decode"),
+                "prefill_buckets": pre,
+                "total": self.program_builds}
+
+    # ------------------------------------------------------------------
+    def prompt_bucket(self, n: int) -> int:
+        return prompt_bucket(n, self.buckets, max_len=self.max_len)
+
+    def prefill(self, prompt, slot: int, key) -> Tuple[object, object]:
+        """Admit one prompt ([t] int) into ``slot``: bucket-pad, run the
+        prefill program, start the cursor at ``prompt_len``. Returns
+        ``(first_token, new_key)`` (device scalars)."""
+        import jax
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be [t] (got {prompt.shape})")
+        bucket = self.prompt_bucket(int(prompt.shape[0]))
+        padded, plen = pad_prompt(prompt, bucket)
+
+        def build():
+            fn = functools.partial(_serve_prefill_impl, self.model,
+                                   self._sample_row)
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        run = self._program(("prefill", bucket), build)
+        tok, key, k, v = run(self.model.params, self.cache.k,
+                             self.cache.v, jnp.asarray(padded)[None],
+                             jnp.asarray(plen, jnp.int32),
+                             jnp.asarray(slot, jnp.int32), key)
+        self.cache.swap(k, v)
+        self.cache.cursors[slot] = plen
+        return tok, key
+
+    def decode(self, tok, positions, keys):
+        """One batched step: ``tok``/``positions`` [S], ``keys`` [S, 2].
+        Returns ``(next_tokens [S], new_keys)``; the pool advances in
+        place (donated buffers)."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            fn = functools.partial(_serve_decode_impl, self.model,
+                                   self._sample_row)
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        run = self._program(("decode", self.slots), build)
+        toks, keys, k, v = run(self.model.params, self.cache.k,
+                               self.cache.v,
+                               jnp.asarray(tok, jnp.int32),
+                               jnp.asarray(positions, jnp.int32), keys)
+        self.cache.swap(k, v)
+        return toks, keys

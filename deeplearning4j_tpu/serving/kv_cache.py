@@ -1,0 +1,80 @@
+"""Slot-based batched KV cache: the device-resident state of the server.
+
+The single-request decoder (``TransformerLM.make_generate``) builds a
+fresh ``prompt_len + max_new`` cache per call — right for one stream,
+wrong for a server: S concurrent requests would run S separate programs
+with S dispatches per emitted token. The slot pool turns that inside
+out: ONE ``[L, S, T_max, Hkv, Dh]`` pair of K/V arrays lives in HBM for
+the server's lifetime, each of the S slots holds one in-flight request
+at its own decode position, and a single jitted step advances all of
+them (``serving/engine.py``).
+
+Slot lifecycle (the scheduler in ``serving/server.py`` drives it):
+
+- **free** — garbage contents, cursor frozen. Safe by construction: the
+  decode mask admits only keys ``<= cursor`` of slots whose rows anyone
+  reads, and a freed slot's rows are never read.
+- **prefill** — an admitted request's bucket-padded prompt runs one
+  batched forward; its per-layer K/V land in ``[slot, 0:P_bucket)`` and
+  the cursor starts at ``prompt_len`` (the pad tail ``[prompt_len,
+  P_bucket)`` sits beyond the mask until generated tokens overwrite it).
+- **decoding** — each step writes the consumed token's K/V at ``cursor``
+  then attends keys ``<= cursor``; the cursor advances by one.
+- **retired** — the request finished; the slot returns to free with its
+  stale contents in place (the next prefill overwrites them, and the
+  mask keeps them unreachable meanwhile).
+
+Cursors are HOST state (plain numpy): the scheduler needs them for
+admission decisions every step boundary, so keeping them device-resident
+would buy one small transfer and cost a readback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["SlotKVCache"]
+
+
+class SlotKVCache:
+    """``[L, S, T_max, Hkv, Dh]`` K/V pools + per-slot write cursors."""
+
+    def __init__(self, model, slots: int, max_len: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if slots < 1:
+            raise ValueError(f"slots={slots} must be >= 1")
+        self.slots = int(slots)
+        self.max_len = int(max_len or model.max_len)
+        if self.max_len < 2:
+            raise ValueError(f"max_len={self.max_len} must be >= 2")
+        if (model.pos_encoding == "learned"
+                and self.max_len > model.max_len):
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the model's learned "
+                f"position table ({model.max_len}); use "
+                "pos_encoding='rope' to serve past it")
+        dh = model.d_model // model.num_heads
+        shape = (model.num_layers, self.slots, self.max_len,
+                 model.num_kv_heads, dh)
+        cdt = model.policy.compute_dtype
+        self.k = jnp.zeros(shape, cdt)
+        self.v = jnp.zeros(shape, cdt)
+        # per-slot write cursor: the position the NEXT consumed token's
+        # K/V lands at (== the absolute position of the last emitted,
+        # not-yet-consumed token)
+        self.cursors = np.zeros(self.slots, np.int32)
+
+    @property
+    def nbytes(self) -> int:
+        """Device footprint of the pool pair (capacity planning: the
+        serving analogue of the epoch cache's HBM budget)."""
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+    def swap(self, new_k, new_v) -> None:
+        """Install the pools a jitted program returned (the old buffers
+        were donated into it)."""
+        self.k = new_k
+        self.v = new_v

@@ -1,0 +1,118 @@
+"""Request model + FIFO admission queue for the decode server.
+
+Scheduler policy (deliberately simple, stated so it can be changed
+deliberately): FIFO admission at step boundaries. A request waits in a
+bounded queue (``DL4J_SERVE_MAX_QUEUE``; overflow rejects at submit —
+backpressure belongs at the edge, not as unbounded memory), and the
+server moves it into the first free slot at the next step boundary. No
+preemption, no priority classes, no prompt-length reordering: continuous
+batching already removes the head-of-line blocking that matters (a long
+generation never stalls admission — new requests join mid-flight the
+moment any slot frees), and FIFO keeps per-request latency analyzable
+under the open-loop load the bench drives.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+__all__ = ["ServeRequest", "ServeQueueFull", "RequestQueue",
+           "serve_slots", "serve_max_queue"]
+
+_IDS = itertools.count(1)
+
+
+def serve_slots(default: int = 8) -> int:
+    """``DL4J_SERVE_SLOTS``: concurrent decode slots S (the batch width
+    of the one compiled decode program)."""
+    raw = os.environ.get("DL4J_SERVE_SLOTS", "")
+    try:
+        return max(1, int(raw)) if raw else default
+    except ValueError:
+        return default
+
+
+def serve_max_queue(default: int = 64) -> int:
+    """``DL4J_SERVE_MAX_QUEUE``: admission queue bound; submits beyond
+    it raise :class:`ServeQueueFull`."""
+    raw = os.environ.get("DL4J_SERVE_MAX_QUEUE", "")
+    try:
+        return max(1, int(raw)) if raw else default
+    except ValueError:
+        return default
+
+
+class ServeQueueFull(RuntimeError):
+    """Backpressure signal: the admission queue is at its bound."""
+
+
+@dataclass
+class ServeRequest:
+    """One generation request and its measured lifecycle.
+
+    Timestamps are the server clock's (injectable, monotonic):
+    ``submit_s`` at enqueue, ``first_token_s`` when the prefill emits
+    the first token (TTFT), ``finish_s`` at retirement. ``tokens`` are
+    the generated tokens only (the caller owns its prompt)."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    seed: int = 0
+    id: int = field(default_factory=lambda: next(_IDS))
+    state: str = "queued"          # queued | running | finished
+    slot: Optional[int] = None
+    submit_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.submit_s is None or self.first_token_s is None:
+            return None
+        return self.first_token_s - self.submit_s
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.submit_s is None or self.finish_s is None:
+            return None
+        return self.finish_s - self.submit_s
+
+    @property
+    def output(self) -> np.ndarray:
+        """``prompt + generated`` — the shape ``generate()`` returns,
+        for equivalence checks."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, self.prompt.dtype)])
+
+
+class RequestQueue:
+    """Bounded FIFO; thread-safe so producers may submit while the
+    serve loop runs on another thread."""
+
+    def __init__(self, max_depth: int):
+        self.max_depth = int(max_depth)
+        self._lock = threading.Lock()
+        self._q: Deque[ServeRequest] = deque()
+
+    def push(self, req: ServeRequest) -> None:
+        with self._lock:
+            if len(self._q) >= self.max_depth:
+                raise ServeQueueFull(
+                    f"serve queue at max depth {self.max_depth}")
+            self._q.append(req)
+
+    def pop(self) -> Optional[ServeRequest]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)

@@ -1,0 +1,231 @@
+"""DecodeServer: continuous batching over the slot pool.
+
+The online counterpart of the batch-oriented eval path (PR 2): a
+persistent server object that compiles its program set once, keeps all
+state device-resident (TensorFlow-paper serving/training split), and
+multiplexes S concurrent requests through ONE jitted decode step.
+
+The loop, per ``step()``:
+
+1. **admit** — pop queued requests into free slots; each admission runs
+   the bucket-compiled prefill (``serve.prefill`` span), records TTFT,
+   and may retire immediately when ``max_new_tokens == 1``.
+2. **decode** — if any slot is live, run the batched decode program
+   once; every live slot appends a token (TPOT per slot), finished
+   requests retire and free their slots.
+
+The host sees one [S] token readback per step — that is the decode
+loop's entire host/device chatter, and it is also the synchronization
+point the per-request results come from. Everything else (queue, slot
+table, cursors) is host bookkeeping the scheduler needs anyway.
+
+Observability: queue depth / occupancy gauges, token + step counters,
+TTFT/TPOT/latency histograms (``monitor/registry``), ``serve.step`` and
+``serve.prefill`` spans (``monitor/trace`` — forwarded to the flight
+recorder when one is live, like every span).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor import metrics, tracer
+from deeplearning4j_tpu.serving.engine import DecodeEngine
+from deeplearning4j_tpu.serving.scheduler import (
+    RequestQueue, ServeRequest, serve_max_queue, serve_slots)
+
+__all__ = ["DecodeServer"]
+
+# histogram buckets tuned for online latency (the default registry
+# ladder tops out too coarse below 10 ms)
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                    float("inf"))
+
+
+class DecodeServer:
+    """Slot-batched online decode server for a :class:`TransformerLM`."""
+
+    def __init__(self, model, *, slots: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 clock=time.monotonic):
+        self.engine = DecodeEngine(
+            model, slots if slots is not None else serve_slots(),
+            max_len=max_len, temperature=temperature, top_k=top_k,
+            buckets=buckets)
+        self.model = model
+        self.slots = self.engine.slots
+        self.max_len = self.engine.max_len
+        self.queue = RequestQueue(
+            max_queue if max_queue is not None else serve_max_queue())
+        self.clock = clock
+        self._slot_req: List[Optional[ServeRequest]] = [None] * self.slots
+        self._last_tok = np.zeros(self.slots, np.int32)
+        self._last_tok_s = np.zeros(self.slots, np.float64)
+        self._keys = self._zero_keys()
+        self.finished: List[ServeRequest] = []
+        self.steps = 0
+        self._reg = metrics()
+
+    def _zero_keys(self):
+        import jax
+        import jax.numpy as jnp
+
+        return jnp.zeros((self.slots,) + jax.random.PRNGKey(0).shape,
+                         jax.random.PRNGKey(0).dtype)
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *,
+               seed: int = 0) -> ServeRequest:
+        """Enqueue one request. Validates against the slot capacity the
+        way ``generate`` validates against its cache size; raises
+        :class:`~.scheduler.ServeQueueFull` at the queue bound."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = int(prompt.shape[0]) + max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {total} exceeds the "
+                f"server's slot capacity max_len={self.max_len}")
+        req = ServeRequest(prompt=prompt, max_new_tokens=max_new_tokens,
+                           seed=seed)
+        req.submit_s = self.clock()
+        try:
+            self.queue.push(req)
+        except Exception:
+            self._reg.counter("serve_requests_total").inc(event="rejected")
+            raise
+        self._reg.counter("serve_requests_total").inc(event="submitted")
+        self._reg.gauge("serve_queue_depth").set(len(self.queue))
+        return req
+
+    # ------------------------------------------------------------------
+    # the serve loop
+    # ------------------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [s for s, r in enumerate(self._slot_req) if r is None]
+
+    def _live_slots(self) -> List[int]:
+        return [s for s, r in enumerate(self._slot_req) if r is not None]
+
+    def occupancy(self) -> float:
+        return len(self._live_slots()) / self.slots
+
+    def busy(self) -> bool:
+        return bool(self._live_slots()) or len(self.queue) > 0
+
+    def _admit(self) -> int:
+        import jax
+
+        admitted = 0
+        for slot in self._free_slots():
+            req = self.queue.pop()
+            if req is None:
+                break
+            with tracer().span("serve.prefill", request=req.id,
+                               slot=slot,
+                               prompt_len=int(req.prompt.shape[0])):
+                key = jax.random.PRNGKey(req.seed)
+                tok, key = self.engine.prefill(req.prompt, slot, key)
+                tok = int(tok)
+            now = self.clock()
+            req.state = "running"
+            req.slot = slot
+            req.first_token_s = now
+            req.tokens.append(tok)
+            self._slot_req[slot] = req
+            self._last_tok[slot] = tok
+            self._last_tok_s[slot] = now
+            self._keys = self._keys.at[slot].set(key)
+            if req.ttft_s is not None:
+                self._reg.histogram("serve_ttft_seconds",
+                                    buckets=_LATENCY_BUCKETS
+                                    ).observe(req.ttft_s)
+            self._reg.counter("serve_tokens_total").inc()
+            admitted += 1
+            if len(req.tokens) >= req.max_new_tokens:
+                self._retire(slot, now)
+        return admitted
+
+    def _retire(self, slot: int, now: float) -> None:
+        req = self._slot_req[slot]
+        req.state = "finished"
+        req.finish_s = now
+        self._slot_req[slot] = None
+        self.finished.append(req)
+        self._reg.counter("serve_requests_total").inc(event="finished")
+        if req.latency_s is not None:
+            self._reg.histogram("serve_request_latency_seconds",
+                                buckets=_LATENCY_BUCKETS
+                                ).observe(req.latency_s)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, then one batched decode step.
+        Returns False when nothing was live (the caller may idle)."""
+        with tracer().span("serve.step") as sp:
+            self._admit()
+            live = self._live_slots()
+            self._reg.gauge("serve_queue_depth").set(len(self.queue))
+            self._reg.gauge("serve_slot_occupancy").set(
+                len(live) / self.slots)
+            if not live:
+                return False
+            toks, self._keys = self.engine.decode(
+                self._last_tok, self.engine.cache.cursors, self._keys)
+            toks = np.asarray(toks)
+            now = self.clock()
+            self.steps += 1
+            sp.attrs["live"] = len(live)
+            self._reg.counter("serve_decode_steps_total").inc()
+            self._reg.counter("serve_tokens_total").inc(len(live))
+            tpot = self._reg.histogram("serve_tpot_seconds",
+                                       buckets=_LATENCY_BUCKETS)
+            for slot in live:
+                req = self._slot_req[slot]
+                req.tokens.append(int(toks[slot]))
+                self.engine.cache.cursors[slot] += 1
+                tpot.observe(now - self._last_tok_s[slot])
+                self._last_tok[slot] = toks[slot]
+                self._last_tok_s[slot] = now
+                if len(req.tokens) >= req.max_new_tokens:
+                    self._retire(slot, now)
+            # re-publish after retirement: a drained server must read 0,
+            # not the pre-retirement batch width
+            self._reg.gauge("serve_slot_occupancy").set(self.occupancy())
+            return True
+
+    def drain(self, max_steps: Optional[int] = None) -> int:
+        """Step until queue and slots are empty; returns steps taken."""
+        taken = 0
+        while self.busy():
+            self.step()
+            taken += 1
+            if max_steps is not None and taken >= max_steps:
+                break
+        return taken
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Artifact-ready snapshot: compile counts, pool footprint,
+        request/step totals."""
+        return {
+            "slots": self.slots,
+            "max_len": self.max_len,
+            "queue_depth": len(self.queue),
+            "occupancy": self.occupancy(),
+            "steps": self.steps,
+            "finished": len(self.finished),
+            "kv_pool_bytes": self.engine.cache.nbytes,
+            "compiles": self.engine.compile_counts(),
+        }

@@ -15,8 +15,10 @@ fresh ``bench.py`` output both feed it), classifies each round —
 — prints the trajectory table (headline value, per-section samples/sec,
 MFU, guard/telemetry overhead), and with ``--check`` exits nonzero when
 the LATEST ok round regresses more than ``--threshold-pct`` against the
-best earlier ok round on any tracked higher-is-better series. Wedge and
-error rounds are called out but never scored (a wedge is an
+best earlier ok round on any tracked series. Each series carries a
+DIRECTION: "higher" (throughput-like — a drop regresses) or "lower"
+(latency-like, e.g. the serve section's p50/p99 — a rise regresses).
+Wedge and error rounds are called out but never scored (a wedge is an
 infrastructure fact, not a perf regression) and never used as a
 baseline.
 
@@ -41,29 +43,51 @@ from typing import Any, Dict, List, Optional, Tuple
 
 WEDGE_MARKERS = ("backend unavailable", "wedge", "did not complete")
 
-# (label, extractor) — every tracked series is higher-is-better; the
+# (label, extractor, direction) — direction is "higher" (throughput-like:
+# a DROP regresses) or "lower" (latency-like: a RISE regresses); the
 # extractor returns None when the round has no honest value for it
 TRACKED = [
-    ("headline", lambda r: r["value"] if r["status"] == "ok" else None),
+    ("headline", lambda r: r["value"] if r["status"] == "ok" else None,
+     "higher"),
     ("transformer_mfu_pct",
-     lambda r: _dig(r, "transformer_lm", "mfu_pct")),
+     lambda r: _dig(r, "transformer_lm", "mfu_pct"), "higher"),
     ("transformer_tokens_per_sec",
-     lambda r: _dig(r, "transformer_lm", "tokens_per_sec")),
+     lambda r: _dig(r, "transformer_lm", "tokens_per_sec"), "higher"),
     ("resnet18_mfu_pct",
-     lambda r: _dig(r, "resnet18_cifar10", "mfu_pct")),
+     lambda r: _dig(r, "resnet18_cifar10", "mfu_pct"), "higher"),
     ("resnet18_samples_per_sec",
-     lambda r: _dig(r, "resnet18_cifar10", "samples_per_sec")),
+     lambda r: _dig(r, "resnet18_cifar10", "samples_per_sec"), "higher"),
     ("mnist_mlp_samples_per_sec",
-     lambda r: _dig(r, "mnist_mlp", "samples_per_sec")),
+     lambda r: _dig(r, "mnist_mlp", "samples_per_sec"), "higher"),
     ("lenet5_samples_per_sec",
-     lambda r: _dig(r, "lenet5", "samples_per_sec")),
+     lambda r: _dig(r, "lenet5", "samples_per_sec"), "higher"),
     ("gemm_peak_tflops",
-     lambda r: _dig(r, "gemm", "peak_achieved_tflops")),
+     lambda r: _dig(r, "gemm", "peak_achieved_tflops"), "higher"),
     ("epoch_speedup",
-     lambda r: _dig(r, "epoch", "speedup")),
+     lambda r: _dig(r, "epoch", "speedup"), "higher"),
     ("dp_epoch_samples_per_sec_per_chip",
-     lambda r: _dig(r, "dp_epoch", "samples_per_sec_per_chip")),
+     lambda r: _dig(r, "dp_epoch", "samples_per_sec_per_chip"), "higher"),
+    # the serve section: latency percentiles gate lower-is-better —
+    # before per-metric direction existed these could only ride in the
+    # table, never fail the gate
+    ("serve_tokens_per_sec",
+     lambda r: _dig(r, "serve", "tokens_per_sec"), "higher"),
+    ("serve_p50_latency_ms",
+     lambda r: _dig(r, "serve", "p50_latency_ms"), "lower"),
+    ("serve_p99_latency_ms",
+     lambda r: _dig(r, "serve", "p99_latency_ms"), "lower"),
+    ("serve_ttft_p50_ms",
+     lambda r: _dig(r, "serve", "ttft_p50_ms"), "lower"),
 ]
+
+# direction lookup for scored series; headline:* keys inherit "higher"
+DIRECTIONS = {label: direction for label, _, direction in TRACKED}
+
+
+def series_direction(label: str) -> str:
+    if label.startswith("headline:"):
+        return "higher"
+    return DIRECTIONS.get(label, "higher")
 
 # lower-is-better overhead columns: reported in the table, not gated
 OVERHEADS = [
@@ -161,7 +185,7 @@ def build_series(rows: List[dict]) -> Dict[str, List[Tuple[int, float]]]:
     series (r01's lenet headline and r03's transformer headline are
     different experiments, not a trajectory)."""
     series: Dict[str, List[Tuple[int, float]]] = {}
-    for label, extract in TRACKED:
+    for label, extract, _direction in TRACKED:
         pts = []
         for row in rows:
             # unnumbered rounds cannot be ordered into a trajectory
@@ -180,22 +204,32 @@ def build_series(rows: List[dict]) -> Dict[str, List[Tuple[int, float]]]:
 
 def find_regressions(series: Dict[str, List[Tuple[int, float]]],
                      threshold_pct: float) -> List[str]:
-    """Latest ok point vs the best EARLIER ok point per series; a drop
-    beyond the threshold is a regression."""
+    """Latest ok point vs the best EARLIER ok point per series, where
+    "best" follows the series direction: max for higher-is-better
+    (throughput — a drop regresses), min for lower-is-better (latency —
+    a rise regresses)."""
     out = []
     for label, pts in sorted(series.items()):
         pts = sorted(pts)
         if len(pts) < 2:
             continue
         (last_round, last), earlier = pts[-1], pts[:-1]
-        best_round, best = max(earlier, key=lambda p: p[1])
-        if best <= 0:
-            continue
-        drop_pct = 100.0 * (best - last) / best
-        if drop_pct > threshold_pct:
+        if series_direction(label) == "lower":
+            best_round, best = min(earlier, key=lambda p: p[1])
+            if best <= 0:
+                continue
+            delta_pct = 100.0 * (last - best) / best
+            verb = "above"
+        else:
+            best_round, best = max(earlier, key=lambda p: p[1])
+            if best <= 0:
+                continue
+            delta_pct = 100.0 * (best - last) / best
+            verb = "below"
+        if delta_pct > threshold_pct:
             out.append(
                 f"{label}: r{last_round:02d} = {last:,.1f} is "
-                f"{drop_pct:.1f}% below r{best_round:02d} = {best:,.1f} "
+                f"{delta_pct:.1f}% {verb} r{best_round:02d} = {best:,.1f} "
                 f"(threshold {threshold_pct:.0f}%)")
     return out
 
@@ -275,7 +309,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "goodput_pct": _dig_ledger(row),
                 "badput": _dig_ledger(row, "badput"),
             }
-            for label, extract in TRACKED[1:]:
+            for label, extract, _direction in TRACKED[1:]:
                 entry[label] = extract(row)
             for label, keys in OVERHEADS:
                 entry[label] = _dig(row, *keys)
@@ -283,6 +317,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps({
             "rounds": compact,
             "series": {k: v for k, v in sorted(series.items())},
+            "directions": {k: series_direction(k) for k in series},
             "threshold_pct": args.threshold_pct,
             "regressions": regressions,
         }))

@@ -28,6 +28,12 @@
 #                                      no-bare-counters lint rule and the
 #                                      flight-recorder write → kill -9 →
 #                                      report round trip)
+#        scripts/verify.sh --serve    (just the online-serving suite —
+#                                      batched slot decode vs generate
+#                                      equivalence, continuous batching,
+#                                      compile flatness, prompt ladder,
+#                                      loadgen — plus the host-sync lint
+#                                      over the serve hot path)
 #        scripts/verify.sh --lint     (static analysis gate: the full
 #                                      dl4j-lint ruleset over the tree +
 #                                      the program-contract checks and
@@ -40,9 +46,9 @@
 #                                      over the committed BENCH_r*.json
 #                                      trajectory; nonzero exit on a
 #                                      bench regression)
-# The eval/epoch/dp/heal/obs/lint/profile tests are part of the default
-# tier-1 run; --eval/--epoch/--dp/--heal/--obs/--lint/--profile are the
-# narrow fast paths for iterating on those surfaces.
+# The eval/epoch/dp/heal/obs/serve/lint/profile tests are part of the
+# default tier-1 run; --eval/--epoch/--dp/--heal/--obs/--serve/--lint/
+# --profile are the narrow fast paths for iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -71,6 +77,15 @@ elif [ "${1:-}" = "--obs" ]; then
     # -9'd mid-chunk, and the surviving segments must reconstruct the
     # timeline and classify the death as 'crashed'
     python scripts/flight_report.py --selftest || exit 1
+elif [ "${1:-}" = "--serve" ]; then
+    shift
+    TARGET=tests/test_serving.py
+    # the decode loop's host-sync guard rides along: the serve program
+    # bodies (serving/engine.py hot roots) must stay free of host
+    # readbacks — the one sanctioned [S] token readback lives in
+    # server.py, outside the traced surface
+    python scripts/dl4j_lint.py --select host-sync-in-hot-path \
+        deeplearning4j_tpu/serving || exit 1
 elif [ "${1:-}" = "--lint" ]; then
     shift
     # static-analysis gate: source-level ruleset first (stdlib-only,

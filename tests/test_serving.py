@@ -1,0 +1,491 @@
+"""Online serving subsystem: batched slot decode vs single-request
+``generate`` equivalence, continuous batching, compile flatness, the
+prompt-length ladder, the persisted compilation cache, the Poisson load
+generator, and the direction-aware bench regression gate.
+
+The load-bearing claims:
+
+1. A slot's token sequence is IDENTICAL to ``TransformerLM.generate``
+   on the same prompt — greedy and sampled (per-slot RNG replays the
+   single-request ``split`` chain) — across learned/RoPE positions, GQA,
+   sliding windows, bucket padding, and slot recycling.
+2. The server compiles one decode program per slot count and one
+   prefill per prompt-ladder rung, and a ragged stream adds ZERO
+   programs after warmup.
+3. ``generate_beam(beam_size=1)`` is greedy ``generate``.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.models.transformer import TransformerLM
+from deeplearning4j_tpu.monitor import metrics, set_tracer, SpanTracer
+from deeplearning4j_tpu.perf.bucketing import (
+    DEFAULT_PROMPT_BUCKETS, pad_prompt, prompt_bucket)
+from deeplearning4j_tpu.serving import (
+    DecodeServer, ServeQueueFull, SlotKVCache, compile_cache_stats,
+    ensure_compile_cache, poisson_schedule, run_open_loop,
+    serve_max_queue, serve_slots)
+from deeplearning4j_tpu.serving import compile_cache as compile_cache_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench_report():
+    spec = importlib.util.spec_from_file_location(
+        "bench_report_serving", os.path.join(REPO, "scripts",
+                                             "bench_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_report = _load_bench_report()
+
+
+def _lm(pos_encoding="learned", **kw):
+    cfg = dict(vocab_size=61, d_model=32, num_heads=4, num_kv_heads=2,
+               num_layers=2, max_len=96, seed=3,
+               pos_encoding=pos_encoding)
+    cfg.update(kw)
+    return TransformerLM(**cfg).init()
+
+
+def _prompts(rng, lens, vocab=61):
+    return [rng.integers(1, vocab, n).astype(np.int32) for n in lens]
+
+
+class FakeClock:
+    """Monotonic fake: every read advances ``tick`` so durations are
+    nonzero and deterministic; ``sleep`` jumps the idle gaps."""
+
+    def __init__(self, tick=0.01):
+        self.t = 0.0
+        self.tick = tick
+
+    def __call__(self):
+        self.t += self.tick
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+# ---------------------------------------------------------------------------
+# prompt-length ladder (perf/bucketing.py satellite)
+# ---------------------------------------------------------------------------
+class TestPromptLadder:
+    def test_rungs_are_smallest_upper_bound(self):
+        assert prompt_bucket(1) == 16
+        assert prompt_bucket(16) == 16
+        assert prompt_bucket(17) == 32
+        assert prompt_bucket(100) == 128
+
+    def test_max_len_caps_the_rung(self):
+        # 100 -> 128 would overflow a 120-slot pool: cap at max_len
+        assert prompt_bucket(100, max_len=120) == 120
+        assert prompt_bucket(100, max_len=4096) == 128
+
+    def test_invalid_lengths_raise(self):
+        with pytest.raises(ValueError):
+            prompt_bucket(0)
+        with pytest.raises(ValueError):
+            prompt_bucket(130, max_len=120)
+
+    def test_disable_flag_makes_prompts_exact(self, monkeypatch):
+        monkeypatch.setenv("DL4J_DISABLE_BUCKETING", "1")
+        assert prompt_bucket(13) == 13
+
+    def test_pad_prompt_roundtrip(self):
+        p = np.arange(1, 6, dtype=np.int32)
+        padded, n = pad_prompt(p, 16)
+        assert n == 5
+        assert padded.shape == (16,)
+        assert padded.dtype == np.int32
+        assert np.array_equal(padded[:5], p)
+        assert not padded[5:].any()
+
+    def test_pad_prompt_batched_and_overflow(self):
+        p = np.ones((2, 7), np.int32)
+        padded, n = pad_prompt(p, 8)
+        assert padded.shape == (2, 8) and n == 7
+        with pytest.raises(ValueError):
+            pad_prompt(np.ones(9, np.int32), 8)
+
+    def test_ladder_stays_off_training_eval_paths(self):
+        # the serving ladder is a separate constant: the batch ladder
+        # the eval path uses must not silently grow prompt rungs
+        from deeplearning4j_tpu.perf.bucketing import DEFAULT_BATCH_BUCKETS
+        assert DEFAULT_PROMPT_BUCKETS != DEFAULT_BATCH_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# equivalence: batched slot decode vs single-request generate
+# ---------------------------------------------------------------------------
+class TestDecodeEquivalence:
+    @pytest.mark.parametrize("pos_encoding", ["learned", "rope"])
+    def test_greedy_matches_generate(self, rng, pos_encoding):
+        """Three concurrent requests at ragged prompt/generation lengths
+        through 2 slots (forces recycling) — token-for-token identical
+        to the per-request ``generate`` programs."""
+        lm = _lm(pos_encoding)
+        prompts = _prompts(rng, (5, 11, 23))
+        max_new = [7, 4, 9]
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, max_new)]
+        srv = DecodeServer(lm, slots=2, max_len=96)
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, max_new)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert np.array_equal(req.output, ref)
+
+    def test_sampled_matches_generate_per_slot_rng(self, rng):
+        """Each slot's RNG stream replays the single-request
+        ``sample``/``split`` chain: serving with ``seed=s`` emits the
+        same tokens as ``generate(..., seed=s)``."""
+        lm = _lm(num_kv_heads=4)  # H == Hkv: the dense-attention path
+        prompts = _prompts(rng, (5, 11))
+        refs = [np.asarray(lm.generate(
+            p[None], 6, temperature=0.7, top_k=13, seed=s))[0]
+            for s, p in enumerate(prompts)]
+        srv = DecodeServer(lm, slots=2, max_len=96, temperature=0.7,
+                           top_k=13)
+        reqs = [srv.submit(p, 6, seed=s) for s, p in enumerate(prompts)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+
+    def test_sliding_window_matches_generate(self, rng):
+        lm = _lm("rope", attn_window=8)
+        p = _prompts(rng, (13,))[0]
+        ref = np.asarray(lm.generate(p[None], 10))[0]
+        srv = DecodeServer(lm, slots=3, max_len=64)
+        req = srv.submit(p, 10)
+        srv.drain()
+        assert np.array_equal(req.output, ref)
+
+    def test_slot_recycling_preserves_tokens(self, rng):
+        """6 requests through 2 slots: retired slots' stale K/V must be
+        unreachable for their successors (the mask-correctness claim of
+        the slot lifecycle)."""
+        lm = _lm()
+        prompts = _prompts(rng, (3, 9, 17, 5, 21, 7))
+        max_new = [5, 2, 6, 8, 3, 4]
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, max_new)]
+        srv = DecodeServer(lm, slots=2, max_len=96)
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, max_new)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+
+    def test_bucket_padding_is_mask_correct(self, rng, monkeypatch):
+        """The same prompt served bucket-padded and exact produces the
+        same tokens — the pad tail is causally unreachable."""
+        lm = _lm("rope")
+        p = _prompts(rng, (9,))[0]
+        srv = DecodeServer(lm, slots=1, max_len=96)  # pads 9 -> 16
+        req = srv.submit(p, 8)
+        srv.drain()
+        monkeypatch.setenv("DL4J_DISABLE_BUCKETING", "1")
+        exact = DecodeServer(lm, slots=1, max_len=96)  # compiles at 9
+        req2 = exact.submit(p, 8)
+        exact.drain()
+        assert exact.engine.compile_counts()["prefill_buckets"] == [9]
+        assert np.array_equal(req.output, req2.output)
+
+    def test_max_new_tokens_one_needs_no_decode_step(self, rng):
+        lm = _lm()
+        p = _prompts(rng, (6,))[0]
+        ref = np.asarray(lm.generate(p[None], 1))[0]
+        srv = DecodeServer(lm, slots=2, max_len=96)
+        req = srv.submit(p, 1)
+        srv.drain()
+        assert np.array_equal(req.output, ref)
+        assert srv.steps == 0  # retired at admission, no decode dispatch
+
+    def test_beam_size_one_is_greedy_generate(self, rng):
+        lm = _lm()
+        prompt = np.stack(_prompts(rng, (7, 7)))
+        greedy = np.asarray(lm.generate(prompt, 6))
+        seqs, scores = lm.generate_beam(prompt, 6, beam_size=1)
+        assert np.asarray(seqs).shape == (2, 1, 13)
+        assert np.array_equal(np.asarray(seqs)[:, 0], greedy)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching mechanics
+# ---------------------------------------------------------------------------
+class TestContinuousBatching:
+    def test_compile_count_flat_after_warmup(self, rng):
+        """A second ragged wave over the same ladder rungs adds ZERO
+        programs — the acceptance invariant the bench asserts on-chip."""
+        lm = _lm()
+        srv = DecodeServer(lm, slots=3, max_len=96)
+        before = metrics().counter("serve_program_builds_total").value(
+            kind="prefill")
+        for p, m in zip(_prompts(rng, (5, 12, 30)), (4, 3, 5)):
+            srv.submit(p, m)
+        srv.drain()
+        warm = srv.engine.program_builds
+        assert srv.engine.compile_counts() == {
+            "decode": 1, "prefill_buckets": [16, 32], "total": 3}
+        assert metrics().counter("serve_program_builds_total").value(
+            kind="prefill") == before + 2
+        # steady state: same rung menu, different lengths/counts
+        for p, m in zip(_prompts(rng, (7, 16, 25, 9)), (2, 5, 3, 4)):
+            srv.submit(p, m)
+        srv.drain()
+        assert srv.engine.program_builds == warm
+        assert len(srv.finished) == 7
+
+    def test_queue_bound_rejects_with_backpressure(self, rng):
+        lm = _lm()
+        srv = DecodeServer(lm, slots=1, max_queue=2, max_len=96)
+        reg = metrics()
+        rejected0 = reg.counter("serve_requests_total").value(
+            event="rejected")
+        srv.submit(_prompts(rng, (4,))[0], 3)
+        srv.submit(_prompts(rng, (4,))[0], 3)
+        with pytest.raises(ServeQueueFull):
+            srv.submit(_prompts(rng, (4,))[0], 3)
+        assert reg.counter("serve_requests_total").value(
+            event="rejected") == rejected0 + 1
+        srv.drain()
+        assert len(srv.finished) == 2
+
+    def test_submit_validation(self, rng):
+        lm = _lm()
+        srv = DecodeServer(lm, slots=1, max_len=32)
+        with pytest.raises(ValueError):
+            srv.submit(np.empty(0, np.int32), 4)
+        with pytest.raises(ValueError):
+            srv.submit(_prompts(rng, (4,))[0], 0)
+        with pytest.raises(ValueError):
+            srv.submit(_prompts(rng, (30,))[0], 4)  # 34 > max_len
+
+    def test_slot_capacity_validation(self):
+        lm = _lm("learned")
+        with pytest.raises(ValueError):
+            SlotKVCache(lm, slots=0)
+        with pytest.raises(ValueError):
+            # learned table bounds the slot capacity the way it bounds
+            # generate(); rope does not (second construction succeeds)
+            SlotKVCache(lm, slots=2, max_len=200)
+        rope = _lm("rope")
+        assert SlotKVCache(rope, slots=2, max_len=200).max_len == 200
+
+    def test_metrics_and_spans(self, rng):
+        """TTFT/latency histograms, token counters, occupancy gauge,
+        and the serve.step/serve.prefill spans all record."""
+        lm = _lm()
+        tr = SpanTracer()
+        set_tracer(tr)
+        try:
+            reg = metrics()
+            ttft0 = reg.histogram("serve_ttft_seconds").value()["count"]
+            lat0 = reg.histogram(
+                "serve_request_latency_seconds").value()["count"]
+            tok0 = reg.counter("serve_tokens_total").value()
+            srv = DecodeServer(lm, slots=2, max_len=96)
+            reqs = [srv.submit(p, 4) for p in _prompts(rng, (5, 9))]
+            srv.drain()
+            assert all(r.ttft_s is not None and r.ttft_s >= 0
+                       for r in reqs)
+            assert all(r.latency_s is not None and r.latency_s >= 0
+                       for r in reqs)
+            assert reg.histogram("serve_ttft_seconds").value(
+                )["count"] == ttft0 + 2
+            assert reg.histogram("serve_request_latency_seconds").value(
+                )["count"] == lat0 + 2
+            assert reg.counter("serve_tokens_total").value() == tok0 + 8
+            assert reg.gauge("serve_slot_occupancy").value() == 0.0
+            names = {sp.name for sp in tr.spans()}
+            assert {"serve.step", "serve.prefill"} <= names
+            prefills = [sp for sp in tr.spans()
+                        if sp.name == "serve.prefill"]
+            assert {sp.attrs["prompt_len"] for sp in prefills} == {5, 9}
+        finally:
+            set_tracer(None)
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("DL4J_SERVE_SLOTS", "5")
+        monkeypatch.setenv("DL4J_SERVE_MAX_QUEUE", "11")
+        assert serve_slots() == 5
+        assert serve_max_queue() == 11
+        monkeypatch.setenv("DL4J_SERVE_SLOTS", "bogus")
+        assert serve_slots() == 8
+        monkeypatch.delenv("DL4J_SERVE_SLOTS")
+        monkeypatch.delenv("DL4J_SERVE_MAX_QUEUE")
+        assert serve_slots() == 8
+        assert serve_max_queue() == 64
+
+
+# ---------------------------------------------------------------------------
+# persisted XLA compilation cache
+# ---------------------------------------------------------------------------
+class TestCompileCache:
+    def test_lazy_configuration(self, tmp_path, monkeypatch):
+        prev = jax.config.jax_compilation_cache_dir
+        d = str(tmp_path / "xla-cache")
+        monkeypatch.setenv("DL4J_COMPILE_CACHE_DIR", d)
+        compile_cache_mod._reset_for_tests()
+        try:
+            assert ensure_compile_cache() == d
+            assert jax.config.jax_compilation_cache_dir == d
+            assert os.path.isdir(d)
+            stats = compile_cache_stats()
+            assert stats["dir"] == d and stats["configured"]
+            # idempotent: second call is a no-op, same answer
+            assert ensure_compile_cache() == d
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            compile_cache_mod._reset_for_tests()
+
+    def test_unset_env_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("DL4J_COMPILE_CACHE_DIR", raising=False)
+        compile_cache_mod._reset_for_tests()
+        assert ensure_compile_cache() is None
+        assert compile_cache_stats() == {
+            "dir": None, "configured": False, "entries": 0, "bytes": 0}
+
+
+# ---------------------------------------------------------------------------
+# Poisson open-loop load generator
+# ---------------------------------------------------------------------------
+class TestLoadGenerator:
+    def test_schedule_is_deterministic_and_ragged(self):
+        a = poisson_schedule(20, 50.0, vocab_size=61, seed=7)
+        b = poisson_schedule(20, 50.0, vocab_size=61, seed=7)
+        assert len(a) == 20
+        assert all(x.arrival_s <= y.arrival_s for x, y in zip(a, a[1:]))
+        assert {x.prompt.shape[0] for x in a} > {a[0].prompt.shape[0]}
+        for x, y in zip(a, b):
+            assert x.arrival_s == y.arrival_s
+            assert np.array_equal(x.prompt, y.prompt)
+
+    def test_open_loop_run_reports(self, rng):
+        lm = _lm()
+        clock = FakeClock()
+        srv = DecodeServer(lm, slots=2, max_len=96, clock=clock)
+        sched = poisson_schedule(
+            8, 100.0, vocab_size=61, prompt_lens=(5, 9),
+            max_new_tokens=(2, 4), seed=3)
+        report = run_open_loop(srv, sched, clock=clock,
+                               sleep=clock.sleep)
+        s = report.summary()
+        assert s["finished"] == 8 and s["rejected"] == 0
+        assert s["tokens"] == sum(len(r.tokens) for r in srv.finished)
+        assert s["p50_latency_ms"] > 0
+        assert s["p99_latency_ms"] >= s["p50_latency_ms"]
+        assert s["ttft_p50_ms"] > 0
+        assert 0 < s["occupancy_mean"] <= 1
+        assert s["tokens_per_sec"] > 0
+
+    def test_open_loop_drops_on_overflow(self, rng):
+        """Open loop means overflow drops — the stream must not turn
+        into a closed loop behind the queue bound."""
+        lm = _lm()
+        clock = FakeClock(tick=0.001)
+        srv = DecodeServer(lm, slots=1, max_queue=1, max_len=96,
+                           clock=clock)
+        # all arrivals at ~t=0: one runs, one queues, the rest reject
+        sched = poisson_schedule(
+            6, 1e6, vocab_size=61, prompt_lens=(5,),
+            max_new_tokens=(6,), seed=0)
+        report = run_open_loop(srv, sched, clock=clock,
+                               sleep=clock.sleep)
+        assert report.rejected > 0
+        assert report.finished + report.rejected == 6
+        assert report.finished == len(srv.finished)
+
+    @pytest.mark.slow
+    def test_soak_ragged_stream_never_recompiles(self, rng):
+        """Soak: 60 ragged requests through 4 slots; after the first
+        rung-covering wave the program count never moves, and every
+        request finishes with exactly max_new tokens."""
+        lm = _lm("rope")
+        clock = FakeClock(tick=0.001)
+        srv = DecodeServer(lm, slots=4, max_len=96, clock=clock)
+        warm = poisson_schedule(
+            8, 500.0, vocab_size=61, prompt_lens=(4, 12, 20, 40),
+            max_new_tokens=(3, 6), seed=1)
+        run_open_loop(srv, warm, clock=clock, sleep=clock.sleep)
+        builds = srv.engine.program_builds
+        soak = poisson_schedule(
+            60, 500.0, vocab_size=61, prompt_lens=(4, 12, 20, 40),
+            max_new_tokens=(3, 6), seed=2)
+        report = run_open_loop(srv, soak, clock=clock, sleep=clock.sleep)
+        assert srv.engine.program_builds == builds
+        assert report.finished == 60
+        for req in srv.finished:
+            assert len(req.tokens) == req.max_new_tokens
+
+
+# ---------------------------------------------------------------------------
+# direction-aware bench regression gate (scripts/bench_report.py)
+# ---------------------------------------------------------------------------
+class TestBenchReportDirections:
+    def test_latency_rise_is_a_regression(self):
+        series = {"serve_p50_latency_ms": [(1, 100.0), (2, 150.0)]}
+        out = bench_report.find_regressions(series, 20.0)
+        assert len(out) == 1 and "above" in out[0]
+
+    def test_latency_drop_is_an_improvement(self):
+        series = {"serve_p99_latency_ms": [(1, 100.0), (2, 60.0)]}
+        assert bench_report.find_regressions(series, 20.0) == []
+
+    def test_throughput_direction_unchanged(self):
+        assert bench_report.find_regressions(
+            {"serve_tokens_per_sec": [(1, 100.0), (2, 70.0)]}, 20.0)
+        assert not bench_report.find_regressions(
+            {"serve_tokens_per_sec": [(1, 100.0), (2, 130.0)]}, 20.0)
+
+    def test_lower_best_baseline_is_the_min(self):
+        # r1's 80 is the best earlier point, not r2's 200: a 100 latest
+        # is 25% above it -> regression even though it beats r2
+        series = {"serve_p50_latency_ms": [(1, 80.0), (2, 200.0),
+                                           (3, 100.0)]}
+        out = bench_report.find_regressions(series, 20.0)
+        assert len(out) == 1 and "r01" in out[0]
+
+    def _write_round(self, path, n, serve):
+        row = {"metric": "m", "value": 100.0, "unit": "u",
+               "extras": {"serve": serve}}
+        path.write_text(json.dumps({"n": n, "rc": 0, "parsed": row}))
+
+    def test_end_to_end_gate_on_serve_section(self, tmp_path, capsys):
+        a = tmp_path / "BENCH_r01.json"
+        b = tmp_path / "BENCH_r02.json"
+        self._write_round(a, 1, {"p50_latency_ms": 10.0,
+                                 "p99_latency_ms": 20.0,
+                                 "ttft_p50_ms": 5.0,
+                                 "tokens_per_sec": 1000.0})
+        self._write_round(b, 2, {"p50_latency_ms": 30.0,
+                                 "p99_latency_ms": 21.0,
+                                 "ttft_p50_ms": 5.0,
+                                 "tokens_per_sec": 1000.0})
+        rc = bench_report.main(["--check", str(a), str(b)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "serve_p50_latency_ms" in out
+        assert "serve_p99_latency_ms" not in out  # 5% rise, under 20%
+
+    def test_json_mode_carries_directions(self, tmp_path, capsys):
+        a = tmp_path / "BENCH_r01.json"
+        self._write_round(a, 1, {"p50_latency_ms": 10.0,
+                                 "tokens_per_sec": 500.0})
+        rc = bench_report.main(["--json", str(a)])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["directions"]["serve_p50_latency_ms"] == "lower"
+        assert payload["directions"]["serve_tokens_per_sec"] == "higher"
+        row = payload["rounds"][0]
+        assert row["serve_p50_latency_ms"] == 10.0

@@ -75,8 +75,15 @@ HOT_PATH_REGISTRY = frozenset({
     "tree_all_finite",
     # serving/engine.py — the decode server's jitted program bodies (a
     # host sync here would serialize every online token behind a device
-    # readback; the serve loop's ONE sanctioned readback lives in
-    # serving/server.py, outside these roots)
+    # readback; the serve loop's ONE sanctioned readback is the
+    # per-dispatch token block in serving/server.py, outside these
+    # roots). The fast-path roots: the K-step fused scan, the shared
+    # one-step forward it scans, and the speculative draft-round /
+    # multi-token-verify bodies.
     "_serve_prefill_impl",
     "_serve_decode_impl",
+    "_serve_decode_fused_impl",
+    "_serve_spec_impl",
+    "_serve_verify_impl",
+    "_decode_step_body",
 })

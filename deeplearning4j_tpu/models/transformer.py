@@ -86,7 +86,7 @@ class TransformerLM:
                  remat: bool = False, pos_encoding: str = "learned",
                  num_kv_heads: Optional[int] = None,
                  attn_window: Optional[int] = None,
-                 sp_impl: str = "ring"):
+                 sp_impl: str = "ring", scan_layers: bool = False):
         assert d_model % num_heads == 0
         # "auto": Pallas flash kernel when a TPU backend is attached and
         # head_dim maps onto lane tiles; "xla" / "flash" force a path
@@ -126,6 +126,15 @@ class TransformerLM:
             raise ValueError(f"sp_impl={sp_impl!r} must be 'ring' or "
                              "'ulysses'")
         self.sp_impl = sp_impl
+        # scan_layers: run the block stack as ONE lax.scan over stacked
+        # per-layer params instead of a Python loop — the traced program
+        # holds ONE block body regardless of depth (asserted on the scan
+        # jaxpr in tests), so the block math XLA must optimize stops
+        # scaling with num_layers; per-layer cost drops to a dozen
+        # trivial stacking ops (the deep serve/bench configs'
+        # compile-time bound). Composes with remat: the checkpoint wraps
+        # the scan BODY, preserving the O(sqrt) activation-memory trade.
+        self.scan_layers = bool(scan_layers)
         # remat: recompute each block's activations in the backward pass
         # (jax.checkpoint) instead of keeping them live across the whole
         # step — trades ~1/3 more FLOPs for O(sqrt) activation memory, the
@@ -280,8 +289,19 @@ class TransformerLM:
 
         if self.remat:
             block_fn = jax.checkpoint(block_fn)
-        for blk in params["blocks"]:
-            h = block_fn(blk, h)
+        if self.scan_layers:
+            # one scan over the stacked per-layer params: the traced
+            # program holds ONE block body however deep the net is
+            # (outputs match the loop path — asserted <= 1e-6 in
+            # tests/test_models.py; exact equality is not promised
+            # because XLA schedules the scan body independently)
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *params["blocks"])
+            h, _ = lax.scan(lambda c, blk: (block_fn(blk, c), None),
+                            h, stacked)
+        else:
+            for blk in params["blocks"]:
+                h = block_fn(blk, h)
         return policy.cast_output(self._unembed(params, h))
 
     def loss(self, params, tokens, *, mesh=None, sequence_parallel=False):
@@ -395,6 +415,7 @@ class TransformerLM:
             "seed": self.seed, "dtype_policy": self.dtype_policy_name,
             "attn_impl": self.attn_impl, "remat": self.remat,
             "pos_encoding": self.pos_encoding,
+            "scan_layers": self.scan_layers,
         }
 
     def _ensure_init(self):

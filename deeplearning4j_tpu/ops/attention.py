@@ -24,6 +24,24 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _lift_mask(mask: jnp.ndarray, rank: int) -> jnp.ndarray:
+    """Broadcast a keep-mask to a logits rank. ``[b, t_kv]`` padding
+    masks broadcast over heads and queries (the classic shape);
+    ``[b, t_q, t_kv]`` per-query masks additionally vary along the query
+    axis — the KV-cache serving paths need them when every batch row
+    sits at its own ragged position set (speculative verify)."""
+    m = mask.astype(bool)
+    if m.ndim == 2:                      # [b, k]
+        idx = (slice(None),) + (None,) * (rank - 2) + (slice(None),)
+    elif m.ndim == 3:                    # [b, q, k]
+        idx = (slice(None),) + (None,) * (rank - 3) + \
+            (slice(None), slice(None))
+    else:
+        raise ValueError(
+            f"mask must be [b, t_kv] or [b, t_q, t_kv] (got {m.shape})")
+    return m[idx]
+
+
 def causal_band_mask(tq: int, tkv: int, *, window: Optional[int] = None,
                      q_offset=0, k_offset=0) -> jnp.ndarray:
     """[tq, tkv] bool keep-mask for causal attention, optionally banded to
@@ -46,7 +64,7 @@ def dot_product_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
-    mask: Optional[jnp.ndarray] = None,  # [b, t_kv] padding mask (1=keep)
+    mask: Optional[jnp.ndarray] = None,  # [b, t_kv] or [b, t_q, t_kv] keep-mask
     bias: Optional[jnp.ndarray] = None,  # [b, h, t_q, t_kv] additive
     scale: Optional[float] = None,
     window: Optional[int] = None,  # sliding window: k in (q-window, q]
@@ -72,7 +90,7 @@ def dot_product_attention(
                                             window=window),
                            logits, NEG_INF)
     if mask is not None:
-        logits = jnp.where(mask[:, None, None, :].astype(bool), logits, NEG_INF)
+        logits = jnp.where(_lift_mask(mask, 4), logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     # cast probabilities back to the value dtype: the PV contraction runs
     # on the MXU at the bf16 rate with f32 accumulation
@@ -86,7 +104,7 @@ def grouped_query_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
-    mask: Optional[jnp.ndarray] = None,  # [b, t_kv] padding mask (1=keep)
+    mask: Optional[jnp.ndarray] = None,  # [b, t_kv] or [b, t_q, t_kv] keep-mask
     scale: Optional[float] = None,
     window: Optional[int] = None,
 ) -> jnp.ndarray:
@@ -114,8 +132,7 @@ def grouped_query_attention(
         logits = jnp.where(causal_band_mask(tq, k.shape[1], window=window),
                            logits, NEG_INF)
     if mask is not None:
-        logits = jnp.where(mask[:, None, None, None, :].astype(bool),
-                           logits, NEG_INF)
+        logits = jnp.where(_lift_mask(mask, 5), logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     o = jnp.einsum("bhrqk,bkhd->bqhrd", weights.astype(v.dtype), v,
                    preferred_element_type=jnp.float32).astype(v.dtype)

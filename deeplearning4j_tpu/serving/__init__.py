@@ -9,10 +9,15 @@ serving/training split applied to the fused-program framework:
   KV pool: ``[L, S, T_max, Hkv, Dh]`` device-resident K/V with per-slot
   write cursors, so S concurrent requests at different decode positions
   are ONE program's batch dimension.
-- :mod:`~deeplearning4j_tpu.serving.engine` — the two jitted programs
-  (bucket-padded prefill, batched decode step) built on the SAME
+- :mod:`~deeplearning4j_tpu.serving.engine` — the jitted program set
+  (bucket-padded prefill, batched decode step, K-step fused decode,
+  speculative draft/verify rounds) built on the SAME
   ``TransformerLM._block`` math as training; ``@traced`` hot roots for
-  dl4j-lint's host-sync rule.
+  dl4j-lint's host-sync rule. The fast path: ``fuse_steps=K`` turns K
+  tokens into one dispatch, ``kv_dtype="int8"`` shrinks the pool 4x,
+  and a draft model (``draft_layers=N`` shallow self-draft or a
+  provided ``TransformerLM``) makes accepted-tokens/dispatch the
+  headline metric.
 - :mod:`~deeplearning4j_tpu.serving.scheduler` — request model + bounded
   FIFO admission queue (``DL4J_SERVE_SLOTS``/``DL4J_SERVE_MAX_QUEUE``).
 - :mod:`~deeplearning4j_tpu.serving.server` — :class:`DecodeServer`,
@@ -34,12 +39,20 @@ from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
     compile_cache_stats,
     ensure_compile_cache,
 )
-from deeplearning4j_tpu.serving.kv_cache import SlotKVCache  # noqa: F401
+from deeplearning4j_tpu.serving.kv_cache import (  # noqa: F401
+    SlotKVCache,
+    kv_pool_nbytes,
+    max_slots_in_budget,
+    resolve_kv_dtype,
+)
 from deeplearning4j_tpu.serving.engine import DecodeEngine  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     RequestQueue,
     ServeQueueFull,
     ServeRequest,
+    serve_draft_layers,
+    serve_fuse_steps,
+    serve_kv_dtype,
     serve_max_queue,
     serve_slots,
 )
@@ -55,5 +68,8 @@ __all__ = [
     "Arrival", "DecodeEngine", "DecodeServer", "LoadReport",
     "RequestQueue", "ServeQueueFull", "ServeRequest", "SlotKVCache",
     "compile_cache_dir", "compile_cache_stats", "ensure_compile_cache",
-    "poisson_schedule", "run_open_loop", "serve_max_queue", "serve_slots",
+    "kv_pool_nbytes", "max_slots_in_budget", "poisson_schedule",
+    "resolve_kv_dtype", "run_open_loop", "serve_draft_layers",
+    "serve_fuse_steps", "serve_kv_dtype", "serve_max_queue",
+    "serve_slots",
 ]

@@ -1,7 +1,7 @@
 """Batched decode engine: the jitted programs behind the decode server.
 
-Two programs serve any request stream, and the engine never compiles a
-third:
+A bounded program set serves any request stream, and the engine never
+compiles outside it:
 
 - ``("prefill", P_bucket)`` — one bucket-padded prompt forward ([1, P])
   through the SAME ``TransformerLM._block`` math as training, writing the
@@ -12,19 +12,43 @@ third:
   scatter the consumed tokens' K/V at each slot's cursor, attend each row
   against its own masked cache history (GQA-aware — the pool stores
   ``num_kv_heads``), sample one token per row from per-slot RNG streams.
-  One compile per slot count, i.e. one for the server's lifetime.
+  The ``fuse_steps=1`` path: one dispatch per token, exactly the PR-10
+  program.
+- ``("decode_fused", S, K)`` — K decode steps as one ``lax.scan``: the
+  single-step body runs K times in-program (per-slot cursors advance on
+  device, RNG streams split in-program, K/V scatters land per step) and
+  the host sees ONE dispatch + one ``[K, S]`` token block per K tokens.
+  Per-slot ``remaining`` counts freeze retired/short slots mid-scan: a
+  frozen slot's token/cursor/key carry unchanged while its rows ride
+  along computing garbage no one reads.
+- ``("decode_spec", S, K, G)`` — speculative decoding: K rounds per
+  dispatch, each round drafting G tokens with the draft model (its own
+  slot pool, positions derived from the shared cursors), verifying all
+  G+1 candidates with ONE multi-token target forward
+  (``_serve_verify_impl``), and accept/resample-ing per the standard
+  speculative-sampling rule — greedy streams are token-identical to the
+  target model's greedy decode, sampled streams draw from the target
+  model's exact sampling distribution. Each round emits ``accepted + 1``
+  tokens per slot (the +1 is the target's correction/bonus token), so
+  accepted-tokens/dispatch — the headline serve metric — exceeds 1
+  whenever the draft agrees at all.
 
-Both are ``@traced`` hot roots (``analysis/annotations.HOT_PATH_REGISTRY``)
-so dl4j-lint's host-sync rule guards the decode loop: a ``float()`` /
-``np.asarray`` slipped into this module's program bodies is a lint
-finding, not a silent per-token device sync.
+All program bodies are ``@traced`` hot roots
+(``analysis/annotations.HOT_PATH_REGISTRY``) so dl4j-lint's host-sync
+rule guards the decode loop: a ``float()`` / ``np.asarray`` slipped into
+this module's program bodies is a lint finding, not a silent per-token
+device sync. The one sanctioned readback is the per-dispatch token block
+in ``server.py``.
 
 Numerics contract (tests/test_serving.py): a slot's token sequence is
 IDENTICAL to ``TransformerLM.generate`` on the same prompt — greedy and
 sampled (each slot replays the exact ``sample``/``split`` chain of a
-single-request ``generate(seed=...)``). Slot rows are computationally
+single-request ``generate(seed=...)``), at every ``fuse_steps`` and
+under greedy speculative decoding. Slot rows are computationally
 independent (every op is row-wise; masked pad keys contribute exactly
-zero attention weight), so batching requests changes no request's tokens.
+zero attention weight), so batching requests changes no request's
+tokens. Quantized pools (``kv_dtype="int8"``) trade bounded logit error
+(``<= absmax/127`` per K/V element) for 4x capacity.
 """
 
 from __future__ import annotations
@@ -38,7 +62,8 @@ from deeplearning4j_tpu.analysis.annotations import traced
 from deeplearning4j_tpu.perf.bucketing import (
     DEFAULT_PROMPT_BUCKETS, pad_prompt, prompt_bucket)
 from deeplearning4j_tpu.serving.compile_cache import ensure_compile_cache
-from deeplearning4j_tpu.serving.kv_cache import SlotKVCache
+from deeplearning4j_tpu.serving.kv_cache import (
+    SlotKVCache, dequant_slab, requant_write_slab)
 
 __all__ = ["DecodeEngine"]
 
@@ -47,19 +72,21 @@ def _row_sampler(temperature: float, top_k: Optional[int]):
     """Per-row sampler ``(logits [V], key [2]) -> (tok, key)`` replaying
     the exact op sequence of ``make_generate``'s batch-of-one ``sample``
     (logits lifted to [1, V] so the categorical draw consumes the same
-    random bits a single-request decode would)."""
+    random bits a single-request decode would). Filtering goes through
+    ``_filtered_logits_fn`` — the SAME ops the speculative accept-ratio
+    distributions use, so q(d) is by construction the probability the
+    sampler draws ``d`` with (the two cannot drift)."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
+
+    filt = (None if temperature == 0.0
+            else _filtered_logits_fn(temperature, top_k))
 
     def one(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits[None], axis=-1)[0].astype(jnp.int32), \
                 key
-        scaled = logits[None] / temperature
-        if top_k is not None:
-            kth = lax.top_k(scaled, top_k)[0][:, -1]
-            scaled = jnp.where(scaled >= kth[:, None], scaled, -jnp.inf)
+        scaled = filt(logits[None])
         key, sub = jax.random.split(key)
         return jax.random.categorical(sub, scaled, axis=-1)[0].astype(
             jnp.int32), key
@@ -67,8 +94,26 @@ def _row_sampler(temperature: float, top_k: Optional[int]):
     return one
 
 
+def _filtered_logits_fn(temperature: float, top_k: Optional[int]):
+    """Vectorized ``logits [..., V] -> filtered scaled logits`` — the
+    argument ``sample``'s categorical draws from, shared by the draft
+    proposal draw and the accept-ratio distributions so q(d) is exactly
+    the probability the draft sampled ``d`` with."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(logits):
+        scaled = logits / temperature
+        if top_k is not None:
+            kth = lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return scaled
+
+    return f
+
+
 @traced
-def _serve_prefill_impl(model, sample_row, params, pool_k, pool_v,
+def _serve_prefill_impl(model, sample_row, quantized, params, kv,
                         prompt, prompt_len, slot, key):
     """Prefill one bucket-padded prompt ([1, P]) into pool slot ``slot``.
 
@@ -76,7 +121,13 @@ def _serve_prefill_impl(model, sample_row, params, pool_k, pool_v,
     attends keys ``0..i`` — all real tokens — so the K/V written at real
     positions (and the ``prompt_len - 1`` hidden state the first token is
     sampled from) are the unpadded prefill's values. ``prompt_len`` and
-    ``slot`` are traced: one compile per bucket, not per request."""
+    ``slot`` are traced: one compile per bucket, not per request.
+
+    Quantized pools: the slot's per-(layer, head) scales RESET here to
+    the prompt K/V absmax (pad positions masked out of the max — their
+    quantized garbage clips and sits beyond the cursor until real decode
+    writes requantize past it), so a recycled slot never inherits a
+    stale scale."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -92,34 +143,57 @@ def _serve_prefill_impl(model, sample_row, params, pool_k, pool_v,
         h, kk, vv = model._block(blk, h)
         ks.append(kk.astype(cdt))
         vs.append(vv.astype(cdt))
-    # [L, 1, P, Hkv, Dh] written at (layer 0, slot, position 0)
-    pool_k = lax.dynamic_update_slice(
-        pool_k, jnp.stack(ks), (0, slot, 0, 0, 0))
-    pool_v = lax.dynamic_update_slice(
-        pool_v, jnp.stack(vs), (0, slot, 0, 0, 0))
+    kcat = jnp.stack(ks)                     # [L, 1, P, Hkv, Dh]
+    vcat = jnp.stack(vs)
+    if quantized:
+        real = (jnp.arange(p) < prompt_len)[None, None, :, None, None]
+
+        def quant(cat, pool, scale):
+            m = jnp.max(jnp.where(real, jnp.abs(cat.astype(jnp.float32)),
+                                  0.0), axis=(1, 2, 4))     # [L, Hkv]
+            denom = jnp.where(m > 0, m, 1.0)
+            q = jnp.clip(jnp.round(cat.astype(jnp.float32)
+                                   / denom[:, None, None, :, None]
+                                   * 127.0), -127, 127).astype(jnp.int8)
+            pool = lax.dynamic_update_slice(pool, q, (0, slot, 0, 0, 0))
+            scale = lax.dynamic_update_slice(
+                scale, m[:, None, :], (0, slot, 0))
+            return pool, scale
+
+        pool_k, k_scale = quant(kcat, kv["k"], kv["k_scale"])
+        pool_v, v_scale = quant(vcat, kv["v"], kv["v_scale"])
+        new_kv = {"k": pool_k, "v": pool_v,
+                  "k_scale": k_scale, "v_scale": v_scale}
+    else:
+        new_kv = {
+            "k": lax.dynamic_update_slice(
+                kv["k"], kcat.astype(kv["k"].dtype), (0, slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                kv["v"], vcat.astype(kv["v"].dtype), (0, slot, 0, 0, 0)),
+        }
     h_last = jnp.take(h[0], prompt_len - 1, axis=0)        # [D]
     tok, key = sample_row(model._unembed(params, h_last), key)
-    return tok, key, pool_k, pool_v
+    return tok, key, new_kv
 
 
-@traced
-def _serve_decode_impl(model, sample_row, params, pool_k, pool_v,
-                       tok, positions, keys):
-    """ONE decode step for all S slots: consume ``tok[s]`` at
-    ``positions[s]``, write its K/V at that cursor, attend keys
-    ``<= positions[s]`` (window-clipped like training), emit the next
-    token per slot from its own RNG stream. Free slots ride along
-    computing garbage no one reads — their rows are masked out of
-    nothing (rows are independent) and their pool writes land at frozen
-    cursors the admission prefill overwrites."""
-    import jax
+def _decode_step_body(model, params, kv, tok, positions):
+    """ONE decode forward for all S slots: consume ``tok[s]`` at
+    ``positions[s]``, write its (de/re)quantized K/V at that cursor,
+    attend keys ``<= positions[s]`` (window-clipped like training).
+    Returns ``(logits [S, V], new_kv)`` — sampling happens in the
+    callers so the draft path can keep the proposal distribution. Free
+    slots ride along computing garbage no one reads — their rows are
+    masked out of nothing (rows are independent) and their pool writes
+    land at frozen cursors the admission prefill overwrites."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.ops.attention import grouped_query_attention
 
     policy = model.policy
     cdt = policy.compute_dtype
     s = tok.shape[0]
-    t_max = pool_k.shape[2]
+    t_max = kv["k"].shape[2]
+    k_scale = kv.get("k_scale")
+    v_scale = kv.get("v_scale")
     h = jnp.take(params["embed"], tok, axis=0)             # [S, D]
     if model.pos_encoding == "learned":
         h = h + params["pos"][positions]
@@ -128,39 +202,288 @@ def _serve_decode_impl(model, sample_row, params, pool_k, pool_v,
     if model.attn_window is not None:
         live &= (jnp.arange(t_max)[None, :]
                  > positions[:, None] - model.attn_window)
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     rows = jnp.arange(s)
 
     def cached_attention(li):
         def attn(q, kk, vv):
-            ck = pool_k[li].at[rows, positions].set(kk[:, 0].astype(cdt))
-            cv = pool_v[li].at[rows, positions].set(vv[:, 0].astype(cdt))
+            ck, cks = requant_write_slab(
+                kv["k"][li], None if k_scale is None else k_scale[li],
+                kk, rows, positions[:, None])
+            cv, cvs = requant_write_slab(
+                kv["v"][li], None if v_scale is None else v_scale[li],
+                vv, rows, positions[:, None])
             new_k.append(ck)
             new_v.append(cv)
-            return grouped_query_attention(q, ck, cv, mask=live)
+            if cks is not None:
+                new_ks.append(cks)
+                new_vs.append(cvs)
+            return grouped_query_attention(
+                q, dequant_slab(ck, cks, cdt), dequant_slab(cv, cvs, cdt),
+                mask=live)
         return attn
 
     for li, blk in enumerate(params["blocks"]):
         h, _, _ = model._block(blk, h, attention=cached_attention(li),
                                positions=positions[:, None])
     logits = model._unembed(params, h[:, 0])               # [S, V]
+    out = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if new_ks:
+        out["k_scale"] = jnp.stack(new_ks)
+        out["v_scale"] = jnp.stack(new_vs)
+    return logits, out
+
+
+@traced
+def _serve_decode_impl(model, sample_row, params, kv, tok, positions,
+                       keys):
+    """The PR-10 single-step program: one batched forward + per-slot
+    sampling. One host dispatch per token — the ``fuse_steps=1`` path,
+    kept bitwise."""
+    import jax
+
+    logits, new_kv = _decode_step_body(model, params, kv, tok, positions)
     toks, keys = jax.vmap(sample_row)(logits, keys)
-    return toks, keys, jnp.stack(new_k), jnp.stack(new_v)
+    return toks, keys, new_kv
+
+
+@traced
+def _serve_decode_fused_impl(model, sample_row, k_steps, params, kv,
+                             cursors, tok, remaining, keys):
+    """K decode steps as ONE ``lax.scan``: sampling, per-slot RNG
+    splits, K/V scatter writes, and cursor advancement all move
+    in-program. ``remaining[s]`` tokens still owed per slot gates an
+    active mask each step: a slot that hits zero mid-scan self-freezes —
+    token/key/cursor/remaining carry unchanged (its rows still compute,
+    writing garbage at its frozen cursor: a position beyond its mask
+    that the next prefill rewrites). Emits the ``[K, S]`` token block;
+    rows past a slot's remaining repeat its final token and the host
+    truncates by its own bookkeeping."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def body(carry, _):
+        kv, cursors, tok, remaining, keys = carry
+        act = remaining > 0
+        ntok, nkeys, nkv = _serve_decode_impl(
+            model, sample_row, params, kv, tok, cursors, keys)
+        tok = jnp.where(act, ntok, tok)
+        keys = jnp.where(act[:, None], nkeys, keys)
+        cursors = jnp.where(act, cursors + 1, cursors)
+        remaining = jnp.where(act, remaining - 1, remaining)
+        return (nkv, cursors, tok, remaining, keys), tok
+
+    (kv, cursors, _, _, keys), toks = lax.scan(
+        body, (kv, cursors, tok, remaining, keys), None, length=k_steps)
+    return toks, cursors, keys, kv
+
+
+@traced
+def _serve_verify_impl(model, params, kv, toks, positions):
+    """Multi-token target forward for the speculative verify: consume
+    ``toks [S, Q]`` at per-row ``positions [S, Q]`` against the slot
+    pool, scatter-writing every candidate's K/V at its position (the
+    accepted prefix becomes permanent; rejected tails sit beyond the
+    rewound cursor, masked until overwritten). Per-query masks keep
+    causality at ragged per-slot offsets: query q attends pool keys
+    ``<= positions[s, q]``. Returns ``(logits [S, Q, V], new_kv)``."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.attention import grouped_query_attention
+
+    policy = model.policy
+    cdt = policy.compute_dtype
+    s = toks.shape[0]
+    t_max = kv["k"].shape[2]
+    k_scale = kv.get("k_scale")
+    v_scale = kv.get("v_scale")
+    h = jnp.take(params["embed"], toks, axis=0)            # [S, Q, D]
+    if model.pos_encoding == "learned":
+        h = h + params["pos"][positions]
+    h = policy.cast_compute(h)
+    live = (jnp.arange(t_max)[None, None, :]
+            <= positions[:, :, None])                      # [S, Q, T]
+    if model.attn_window is not None:
+        live &= (jnp.arange(t_max)[None, None, :]
+                 > positions[:, :, None] - model.attn_window)
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    rows = jnp.arange(s)
+
+    def cached_attention(li):
+        def attn(q, kk, vv):
+            ck, cks = requant_write_slab(
+                kv["k"][li], None if k_scale is None else k_scale[li],
+                kk, rows, positions)
+            cv, cvs = requant_write_slab(
+                kv["v"][li], None if v_scale is None else v_scale[li],
+                vv, rows, positions)
+            new_k.append(ck)
+            new_v.append(cv)
+            if cks is not None:
+                new_ks.append(cks)
+                new_vs.append(cvs)
+            return grouped_query_attention(
+                q, dequant_slab(ck, cks, cdt), dequant_slab(cv, cvs, cdt),
+                mask=live)
+        return attn
+
+    for li, blk in enumerate(params["blocks"]):
+        h, _, _ = model._block(blk, h, attention=cached_attention(li),
+                               positions=positions)
+    logits = model._unembed(params, h)                     # [S, Q, V]
+    out = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if new_ks:
+        out["k_scale"] = jnp.stack(new_ks)
+        out["v_scale"] = jnp.stack(new_vs)
+    return logits, out
+
+
+@traced
+def _serve_spec_impl(model, draft_model, sample_filtered, gamma, greedy,
+                     k_rounds, params, draft_params, kv, draft_kv,
+                     cursors, tok, remaining, keys, draft_keys):
+    """K speculative rounds as ONE program. Per round and live slot:
+
+    1. **draft** — ``gamma + 1`` draft-model steps from the shared
+       cursors (step j consumes candidate j-1), proposing ``d_1..d_G``
+       and writing every candidate's draft K/V so the draft pool covers
+       the accepted prefix whatever the acceptance turns out to be (the
+       G+1-th step writes ``d_G``'s K/V; its proposal is discarded).
+    2. **verify** — ONE target forward over ``[tok, d_1..d_G]`` at
+       positions ``c..c+G`` (``_serve_verify_impl``), yielding target
+       distributions for every candidate plus the bonus position.
+    3. **accept/resample** — greedy: accept the longest prefix where the
+       target's argmax equals the proposal, then emit the target's own
+       next token (token-identity with unassisted greedy decode by
+       construction). Sampled: the standard speculative-sampling rule —
+       accept ``d_i`` with probability ``min(1, p(d_i)/q(d_i))``, on the
+       first rejection resample from ``norm(max(p - q, 0))``, after full
+       acceptance sample the bonus from ``p`` — which draws from the
+       target model's exact (temperature/top-k filtered) distribution.
+
+    Cursors advance by ``accepted + 1``; the draft pool needs no cursor
+    of its own (positions derive from the shared cursors, and rejected
+    candidates' draft K/V sit beyond the rewound cursor exactly like the
+    target pool's). Emits ``[K, S, G + 2]`` blocks: per round,
+    ``[count, e_1..e_{G+1}]`` per slot (count = 0 for frozen slots)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    i32 = jnp.int32
+
+    def round_body(carry, _):
+        kv, draft_kv, cursors, tok, remaining, keys, draft_keys = carry
+        act = remaining > 0
+
+        # ---- draft: propose gamma candidates, write gamma+1 K/V
+        def dstep(dc, i):
+            dkv, dtok, dkeys = dc
+            logits, dkv = _decode_step_body(
+                draft_model, draft_params, dkv, dtok, cursors + i)
+            if greedy:
+                prop = jnp.argmax(logits, axis=-1).astype(i32)
+                qdist = logits  # unused; placeholder keeps the scan pytree
+            else:
+                scaled = sample_filtered(logits)           # [S, V]
+                qdist = jax.nn.softmax(scaled, axis=-1)
+
+                def draw(key, lg):
+                    key, sub = jax.random.split(key)
+                    return key, jax.random.categorical(sub, lg)
+
+                dkeys, prop = jax.vmap(draw)(dkeys, scaled)
+                prop = prop.astype(i32)
+            return (dkv, prop, dkeys), (prop, qdist)
+
+        (draft_kv, _, draft_keys), (props, qdists) = lax.scan(
+            dstep, (draft_kv, tok, draft_keys), jnp.arange(gamma + 1))
+        d = jnp.swapaxes(props[:gamma], 0, 1)              # [S, G]
+
+        # ---- verify: one multi-token target forward over tok + d_1..d_G
+        vtoks = jnp.concatenate([tok[:, None], d], axis=1)  # [S, G+1]
+        vpos = cursors[:, None] + jnp.arange(gamma + 1)[None, :]
+        logits, kv = _serve_verify_impl(model, params, kv, vtoks, vpos)
+
+        # ---- accept / resample
+        if greedy:
+            t = jnp.argmax(logits, axis=-1).astype(i32)    # [S, G+1]
+            accept = t[:, :gamma] == d                     # [S, G]
+            a = jnp.sum(jnp.cumprod(accept.astype(i32), axis=1), axis=1)
+            corr = jnp.take_along_axis(t, a[:, None], axis=1)[:, 0]
+        else:
+            p = jax.nn.softmax(sample_filtered(logits), axis=-1)
+            q = jnp.swapaxes(qdists[:gamma], 0, 1)         # [S, G, V]
+            p_d = jnp.take_along_axis(
+                p[:, :gamma], d[..., None], axis=-1)[..., 0]
+            q_d = jnp.take_along_axis(q, d[..., None], axis=-1)[..., 0]
+
+            def consume(key):
+                key, su = jax.random.split(key)
+                u = jax.random.uniform(su, (gamma,))
+                key, sc = jax.random.split(key)
+                return key, u, sc
+
+            keys, us, subs = jax.vmap(consume)(keys)
+            # u < min(1, p/q)  <=>  u*q < p  (q=0 proposals never drawn)
+            accept = us * q_d < p_d
+            a = jnp.sum(jnp.cumprod(accept.astype(i32), axis=1), axis=1)
+            p_a = jnp.take_along_axis(
+                p, a[:, None, None], axis=1)[:, 0]         # [S, V]
+            q_pad = jnp.concatenate(
+                [q, jnp.zeros_like(q[:, :1])], axis=1)
+            q_a = jnp.take_along_axis(
+                q_pad, a[:, None, None], axis=1)[:, 0]
+            res = jnp.maximum(p_a - q_a, 0.0)
+            has_res = jnp.sum(res, axis=-1, keepdims=True) > 0
+            res = jnp.where(has_res, res, p_a)
+            corr = jax.vmap(
+                lambda s_, r: jax.random.categorical(
+                    s_, jnp.log(jnp.maximum(r, 1e-38))))(subs, res)
+            corr = corr.astype(i32)
+
+        count = jnp.where(act, a + 1, 0).astype(i32)
+        idx = jnp.arange(gamma + 1)[None, :]
+        d_pad = jnp.concatenate(
+            [d, jnp.zeros_like(d[:, :1])], axis=1)         # [S, G+1]
+        emit = jnp.where(idx < a[:, None], d_pad,
+                         jnp.where(idx == a[:, None], corr[:, None], 0))
+        block = jnp.concatenate([count[:, None], emit], axis=1)
+
+        tok = jnp.where(act, corr, tok)
+        cursors = jnp.where(act, cursors + count, cursors)
+        remaining = jnp.where(act, jnp.maximum(remaining - count, 0),
+                              remaining)
+        return (kv, draft_kv, cursors, tok, remaining, keys,
+                draft_keys), block
+
+    (kv, draft_kv, cursors, _, _, keys, draft_keys), blocks = lax.scan(
+        round_body, (kv, draft_kv, cursors, tok, remaining, keys,
+                     draft_keys), None, length=k_rounds)
+    return blocks, cursors, keys, draft_keys, kv, draft_kv
 
 
 class DecodeEngine:
-    """Owns the slot pool + the per-signature program cache.
+    """Owns the slot pool(s) + the per-signature program cache.
 
     ``temperature``/``top_k`` are server-level (baked into the compiled
     programs — a per-request sampling config would be a program
     signature per config, exactly the recompile hazard the server
     exists to avoid); per-request randomness rides in per-slot keys.
+
+    Speculative decoding: pass ``draft_layers=n`` for a shallow self-
+    draft (the target's first n blocks + its final norm/unembedding —
+    zero extra parameters) or ``draft_model=`` for an independently
+    trained draft ``TransformerLM`` (same vocab). Either builds a second
+    slot pool for the draft's K/V on the same slot machinery.
     """
 
     def __init__(self, model, slots: int, *,
                  max_len: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 kv_dtype: Optional[str] = None,
+                 draft_model=None, draft_layers: int = 0,
+                 spec_tokens: int = 3):
         if temperature < 0.0:
             raise ValueError(f"temperature={temperature} must be >= 0")
         if top_k is not None and not 1 <= top_k <= model.vocab_size:
@@ -168,9 +491,10 @@ class DecodeEngine:
                 f"top_k={top_k} must be in [1, vocab={model.vocab_size}]")
         model._ensure_init()
         self.model = model
-        self.cache = SlotKVCache(model, slots, max_len)
+        self.cache = SlotKVCache(model, slots, max_len, kv_dtype)
         self.slots = self.cache.slots
         self.max_len = self.cache.max_len
+        self.kv_dtype = self.cache.kv_dtype
         self.temperature = float(temperature)
         self.top_k = top_k
         self.buckets = tuple(b for b in (buckets or DEFAULT_PROMPT_BUCKETS)
@@ -178,9 +502,57 @@ class DecodeEngine:
         self._sample_row = _row_sampler(self.temperature, top_k)
         self._programs: Dict[tuple, object] = {}
         self.program_builds = 0
+
+        # ---- speculative-decoding configuration
+        if draft_model is not None and draft_layers:
+            raise ValueError(
+                "pass draft_model= OR draft_layers=, not both")
+        self.spec_tokens = int(spec_tokens)
+        if self.spec_tokens < 1:
+            raise ValueError(f"spec_tokens={spec_tokens} must be >= 1")
+        self.draft_model = None
+        if draft_layers:
+            if not 1 <= draft_layers <= model.num_layers:
+                raise ValueError(
+                    f"draft_layers={draft_layers} must be in "
+                    f"[1, num_layers={model.num_layers}]")
+            self.draft_model = self._shallow_draft(model, draft_layers)
+        elif draft_model is not None:
+            draft_model._ensure_init()
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size}")
+            self.draft_model = draft_model
+        self.draft_cache = None
+        if self.draft_model is not None:
+            # same slot count/positions as the target pool (the
+            # SlotKVCache ctor re-validates learned-table capacity for
+            # the draft's own position table)
+            self.draft_cache = SlotKVCache(
+                self.draft_model, self.slots, self.max_len, kv_dtype)
         # the fleet story: point jax's persistent compilation cache at
         # DL4J_COMPILE_CACHE_DIR before this engine's first compile
         ensure_compile_cache()
+
+    @property
+    def spec(self) -> bool:
+        return self.draft_model is not None
+
+    @staticmethod
+    def _shallow_draft(model, n: int):
+        """Self-draft by layer truncation: the target's first ``n``
+        blocks + its embedding/position/final-norm/unembedding, sharing
+        the target's parameter buffers (a view, not a copy)."""
+        from deeplearning4j_tpu.models.transformer import TransformerLM
+
+        cfg = dict(model.get_config())
+        cfg["num_layers"] = n
+        draft = TransformerLM(**cfg)
+        draft.params = {k: v for k, v in model.params.items()
+                        if k != "blocks"}
+        draft.params["blocks"] = model.params["blocks"][:n]
+        return draft
 
     # ------------------------------------------------------------------
     def _program(self, sig: tuple, factory):
@@ -199,10 +571,12 @@ class DecodeEngine:
 
     def compile_counts(self) -> dict:
         """``{decode, prefill_buckets, total}`` — the warmup-flatness
-        evidence serving artifacts embed."""
-        pre = sorted(s[1] for s in self._programs if s[0] == "prefill")
+        evidence serving artifacts embed (``decode`` counts every
+        decode-family program: plain, fused, speculative)."""
+        pre = sorted(s[1] for s in self._programs
+                     if s[0].startswith("prefill"))
         return {"decode": sum(1 for s in self._programs
-                              if s[0] == "decode"),
+                              if s[0].startswith("decode")),
                 "prefill_buckets": pre,
                 "total": self.program_builds}
 
@@ -210,49 +584,115 @@ class DecodeEngine:
     def prompt_bucket(self, n: int) -> int:
         return prompt_bucket(n, self.buckets, max_len=self.max_len)
 
-    def prefill(self, prompt, slot: int, key) -> Tuple[object, object]:
-        """Admit one prompt ([t] int) into ``slot``: bucket-pad, run the
-        prefill program, start the cursor at ``prompt_len``. Returns
-        ``(first_token, new_key)`` (device scalars)."""
+    def _prefill_one(self, kind, model, cache, padded, plen, slot, key):
         import jax
         import jax.numpy as jnp
+
+        def build():
+            fn = functools.partial(_serve_prefill_impl, model,
+                                   self._sample_row, cache.quantized)
+            return jax.jit(fn, donate_argnums=(1,))
+
+        run = self._program((kind, int(padded.shape[0])), build)
+        tok, key, state = run(model.params, cache.state,
+                              jnp.asarray(padded)[None],
+                              jnp.asarray(plen, jnp.int32),
+                              jnp.asarray(slot, jnp.int32), key)
+        cache.install(state)
+        return tok, key
+
+    def prefill(self, prompt, slot: int, key) -> Tuple[object, object]:
+        """Admit one prompt ([t] int) into ``slot``: bucket-pad, run the
+        prefill program (plus the draft-pool prefill when speculative
+        decoding is on), start the cursor at ``prompt_len``. Returns
+        ``(first_token, new_key)`` (device scalars)."""
+        import jax
 
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be [t] (got {prompt.shape})")
         bucket = self.prompt_bucket(int(prompt.shape[0]))
         padded, plen = pad_prompt(prompt, bucket)
-
-        def build():
-            fn = functools.partial(_serve_prefill_impl, self.model,
-                                   self._sample_row)
-            return jax.jit(fn, donate_argnums=(1, 2))
-
-        run = self._program(("prefill", bucket), build)
-        tok, key, k, v = run(self.model.params, self.cache.k,
-                             self.cache.v, jnp.asarray(padded)[None],
-                             jnp.asarray(plen, jnp.int32),
-                             jnp.asarray(slot, jnp.int32), key)
-        self.cache.swap(k, v)
-        self.cache.cursors[slot] = plen
+        tok, key = self._prefill_one("prefill", self.model, self.cache,
+                                     padded, plen, slot, key)
+        if self.spec:
+            # the draft pool must hold the prompt's K/V too; its sampled
+            # token (and the dummy key) are discarded — the served first
+            # token is the TARGET prefill's
+            self._prefill_one("prefill_draft", self.draft_model,
+                              self.draft_cache, padded, plen, slot,
+                              jax.random.PRNGKey(0))
+        self.cache.set_cursor(slot, plen)
         return tok, key
 
     def decode(self, tok, positions, keys):
-        """One batched step: ``tok``/``positions`` [S], ``keys`` [S, 2].
-        Returns ``(next_tokens [S], new_keys)``; the pool advances in
-        place (donated buffers)."""
+        """One batched step (the ``fuse_steps=1`` / PR-10 path):
+        ``tok``/``positions`` [S], ``keys`` [S, 2]. Returns
+        ``(next_tokens [S], new_keys)``; the pool advances in place
+        (donated buffers) and the CALLER advances the cursors."""
         import jax
         import jax.numpy as jnp
 
         def build():
             fn = functools.partial(_serve_decode_impl, self.model,
                                    self._sample_row)
-            return jax.jit(fn, donate_argnums=(1, 2))
+            return jax.jit(fn, donate_argnums=(1,))
 
         run = self._program(("decode", self.slots), build)
-        toks, keys, k, v = run(self.model.params, self.cache.k,
-                               self.cache.v,
-                               jnp.asarray(tok, jnp.int32),
-                               jnp.asarray(positions, jnp.int32), keys)
-        self.cache.swap(k, v)
+        toks, keys, state = run(self.model.params, self.cache.state,
+                                jnp.asarray(tok, jnp.int32),
+                                jnp.asarray(positions, jnp.int32), keys)
+        self.cache.install(state)
         return toks, keys
+
+    def decode_fused(self, tok, remaining, keys, k_steps: int):
+        """K decode steps as ONE dispatch: returns the ``[K, S]`` token
+        block (device) + new keys; pool and cursors advance in place."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            fn = functools.partial(_serve_decode_fused_impl, self.model,
+                                   self._sample_row, k_steps)
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        run = self._program(("decode_fused", self.slots, k_steps), build)
+        toks, cursors, keys, state = run(
+            self.model.params, self.cache.state, self.cache.cursors,
+            jnp.asarray(tok, jnp.int32),
+            jnp.asarray(remaining, jnp.int32), keys)
+        self.cache.install(state)
+        self.cache.cursors = cursors
+        return toks, keys
+
+    def decode_spec(self, tok, remaining, keys, draft_keys,
+                    k_rounds: int):
+        """K speculative rounds as ONE dispatch: returns the
+        ``[K, S, spec_tokens + 2]`` block (per round and slot:
+        ``[count, tokens...]``) + new target/draft keys; both pools and
+        the cursors advance in place."""
+        import jax
+        import jax.numpy as jnp
+
+        greedy = self.temperature == 0.0
+
+        def build():
+            fn = functools.partial(
+                _serve_spec_impl, self.model, self.draft_model,
+                None if greedy else _filtered_logits_fn(
+                    self.temperature, self.top_k),
+                self.spec_tokens, greedy, k_rounds)
+            return jax.jit(fn, donate_argnums=(2, 3, 4))
+
+        run = self._program(
+            ("decode_spec", self.slots, k_rounds, self.spec_tokens),
+            build)
+        blocks, cursors, keys, draft_keys, state, dstate = run(
+            self.model.params, self.draft_model.params,
+            self.cache.state, self.draft_cache.state, self.cache.cursors,
+            jnp.asarray(tok, jnp.int32),
+            jnp.asarray(remaining, jnp.int32), keys, draft_keys)
+        self.cache.install(state)
+        self.draft_cache.install(dstate)
+        self.cache.cursors = cursors
+        return blocks, keys, draft_keys

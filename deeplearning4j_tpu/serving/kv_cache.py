@@ -24,24 +24,165 @@ Slot lifecycle (the scheduler in ``serving/server.py`` drives it):
   stale contents in place (the next prefill overwrites them, and the
   mask keeps them unreachable meanwhile).
 
-Cursors are HOST state (plain numpy): the scheduler needs them for
-admission decisions every step boundary, so keeping them device-resident
-would buy one small transfer and cost a readback.
+Cursors are a DEVICE ``[S]`` int32 array: the fused multi-token decode
+program (``("decode_fused", S, K)``) advances them in-program across K
+scan steps — per-slot active masks freeze retired/short slots mid-scan —
+so the host never reads them back. The scheduler's admission decisions
+come from its own slot table (which request occupies which slot), not
+from cursor values; cursor writes happen only at fusion boundaries
+(``set_cursor`` at prefill, ``advance`` on the unfused K=1 path).
+
+Quantized pool (``DL4J_SERVE_KV_DTYPE`` / ``kv_dtype=``): the pool is
+the dominant HBM term at high slot counts, so the store dtype is a
+capacity lever — ``float32``, ``bfloat16``, or ``int8``. int8 keeps
+per-(layer, slot, head) absmax scales beside the pool (f32 ``[L, S,
+Hkv]``, a ``1/(T_max·Dh)``-sized sidecar) and dequantizes inside the
+attention body; the pool shrinks 4x vs f32 and ``max_slots_in_budget``
+rises accordingly. Scales are running maxima: a write whose absmax
+exceeds the slot-head's scale requantizes that row in-program
+(``requant_write_slab``), so streamed decode writes never clip.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["SlotKVCache"]
+from deeplearning4j_tpu.analysis.annotations import traced
+
+__all__ = [
+    "SlotKVCache",
+    "resolve_kv_dtype",
+    "kv_pool_nbytes",
+    "max_slots_in_budget",
+    "dequant_slab",
+    "requant_write_slab",
+]
+
+_KV_DTYPES = ("float32", "bfloat16", "int8")
+_ALIASES = {"f32": "float32", "bf16": "bfloat16"}
+
+
+def resolve_kv_dtype(kv_dtype: Optional[str], model) -> str:
+    """Canonical store-dtype name for the pool: an explicit ``kv_dtype``
+    wins, else ``DL4J_SERVE_KV_DTYPE``, else the model's compute dtype
+    (the pre-quantization default — today's behavior, bitwise)."""
+    raw = kv_dtype
+    if raw is None:
+        raw = os.environ.get("DL4J_SERVE_KV_DTYPE", "").strip() or None
+    if raw is None:
+        import jax.numpy as jnp
+
+        return str(jnp.dtype(model.policy.compute_dtype))
+    name = _ALIASES.get(str(raw).lower(), str(raw).lower())
+    if name not in _KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype={raw!r} must be one of {_KV_DTYPES} "
+            "(DL4J_SERVE_KV_DTYPE)")
+    return name
+
+
+def _elem_bytes(name: str) -> int:
+    return {"float32": 4, "bfloat16": 2, "int8": 1}.get(name, 4)
+
+
+def _pool_dims(model, slots: int, max_len: int):
+    dh = model.d_model // model.num_heads
+    return (model.num_layers, slots, max_len, model.num_kv_heads, dh)
+
+
+def kv_pool_nbytes(model, slots: int, max_len: Optional[int] = None,
+                   kv_dtype: Optional[str] = None) -> int:
+    """Analytic device footprint of the K/V pool pair (+ int8 scale
+    sidecars) — the serving term of the HBM budget model. Matches
+    ``SlotKVCache.nbytes`` exactly (asserted in tests)."""
+    name = resolve_kv_dtype(kv_dtype, model)
+    ll, ss, tt, hkv, dh = _pool_dims(model, slots,
+                                     int(max_len or model.max_len))
+    total = 2 * ll * ss * tt * hkv * dh * _elem_bytes(name)
+    if name == "int8":
+        total += 2 * ll * ss * hkv * 4  # f32 per-(layer, slot, head) scales
+    return total
+
+
+def max_slots_in_budget(model, max_len: int, budget_bytes: int,
+                        kv_dtype: Optional[str] = None) -> int:
+    """How many concurrent slots an HBM budget can hold at ``max_len``
+    context — the capacity planning answer quantization multiplies
+    (int8 fits ~4x the slots of float32)."""
+    per_slot = kv_pool_nbytes(model, 1, max_len, kv_dtype)
+    return max(0, int(budget_bytes) // per_slot)
+
+
+# ---------------------------------------------------------------------------
+# int8 codec: traced helpers the engine's program bodies call
+# ---------------------------------------------------------------------------
+@traced
+def dequant_slab(slab, scale, dtype):
+    """Dequantize one layer's pool slab ``[S, T, Hkv, Dh]`` to ``dtype``
+    for the attention body. ``scale is None`` = unquantized store (the
+    slab IS the values; cast only if the store dtype differs)."""
+    import jax.numpy as jnp
+
+    if scale is None:
+        return slab if slab.dtype == dtype else slab.astype(dtype)
+    return (slab.astype(jnp.float32)
+            * (scale[:, None, :, None] / 127.0)).astype(dtype)
+
+
+@traced
+def requant_write_slab(slab, scale, values, rows, positions):
+    """Write ``values [S, q, Hkv, Dh]`` at ``(rows [S], positions
+    [S, q])`` into one layer's slab; returns ``(slab, scale)``.
+
+    Unquantized (``scale is None``): a plain scatter in the store dtype.
+    int8: per-(slot, head) running-absmax scales — when a write's absmax
+    exceeds the stored scale, the slot-head's existing entries are
+    requantized to the grown scale in the same program (slots whose
+    scale did not grow multiply by exactly 1.0 — an int8→f32→round→int8
+    identity), then the new values quantize and scatter. Out-of-range
+    scatter positions (frozen slots riding along near ``T_max``) are
+    dropped by XLA's scatter semantics, never written."""
+    import jax.numpy as jnp
+
+    if scale is None:
+        return slab.at[rows[:, None], positions].set(
+            values.astype(slab.dtype)), None
+    from jax import lax
+
+    vals = values.astype(jnp.float32)
+    m = jnp.max(jnp.abs(vals), axis=(1, 3))                 # [S, Hkv]
+    new_scale = jnp.maximum(scale, m)
+    denom = jnp.where(new_scale > 0, new_scale, 1.0)
+    factor = jnp.where(new_scale > 0, scale / denom, 1.0)
+    # the requant pass rewrites the whole slab, so gate it on any scale
+    # actually growing: in the steady state (absmax already seen) every
+    # factor is 1.0 and the identity rewrite would burn a full
+    # pool-read+write of bandwidth per layer per step for nothing —
+    # cond keeps the common case scatter-only
+    slab = lax.cond(
+        jnp.any(new_scale > scale),
+        lambda s: jnp.round(s.astype(jnp.float32)
+                            * factor[:, None, :, None]).astype(jnp.int8),
+        lambda s: s,
+        slab)
+    q = jnp.clip(jnp.round(vals / denom[:, None, :, None] * 127.0),
+                 -127, 127).astype(jnp.int8)
+    return slab.at[rows[:, None], positions].set(q), new_scale
 
 
 class SlotKVCache:
-    """``[L, S, T_max, Hkv, Dh]`` K/V pools + per-slot write cursors."""
+    """``[L, S, T_max, Hkv, Dh]`` K/V pools + device per-slot cursors."""
 
-    def __init__(self, model, slots: int, max_len: Optional[int] = None):
+    # validate_cache_budget (monitor/memory.py) prices any cache as
+    # nbytes/n_shard vs measured per-device bytes; the slot pool is
+    # single-replica device state
+    n_shard = 1
+
+    def __init__(self, model, slots: int, max_len: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
         import jax.numpy as jnp
 
         if slots < 1:
@@ -56,25 +197,74 @@ class SlotKVCache:
                 f"max_len={self.max_len} exceeds the model's learned "
                 f"position table ({model.max_len}); use "
                 "pos_encoding='rope' to serve past it")
-        dh = model.d_model // model.num_heads
-        shape = (model.num_layers, self.slots, self.max_len,
-                 model.num_kv_heads, dh)
-        cdt = model.policy.compute_dtype
-        self.k = jnp.zeros(shape, cdt)
-        self.v = jnp.zeros(shape, cdt)
+        self.kv_dtype = resolve_kv_dtype(kv_dtype, model)
+        shape = _pool_dims(model, self.slots, self.max_len)
+        if self.kv_dtype == "int8":
+            self.k = jnp.zeros(shape, jnp.int8)
+            self.v = jnp.zeros(shape, jnp.int8)
+            self.k_scale = jnp.zeros(shape[:2] + (shape[3],), jnp.float32)
+            self.v_scale = jnp.zeros(shape[:2] + (shape[3],), jnp.float32)
+        else:
+            self.k = jnp.zeros(shape, jnp.dtype(self.kv_dtype))
+            self.v = jnp.zeros(shape, jnp.dtype(self.kv_dtype))
+            self.k_scale = None
+            self.v_scale = None
         # per-slot write cursor: the position the NEXT consumed token's
         # K/V lands at (== the absolute position of the last emitted,
-        # not-yet-consumed token)
-        self.cursors = np.zeros(self.slots, np.int32)
+        # not-yet-consumed token). DEVICE state: the fused decode scan
+        # advances it in-program; the host only writes it at fusion
+        # boundaries and never reads it back.
+        self.cursors = jnp.zeros(self.slots, jnp.int32)
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def state(self) -> dict:
+        """The pool pytree a jitted program consumes (and is donated):
+        ``{k, v}`` plus the int8 scale sidecars when quantized."""
+        st = {"k": self.k, "v": self.v}
+        if self.k_scale is not None:
+            st["k_scale"] = self.k_scale
+            st["v_scale"] = self.v_scale
+        return st
+
+    def install(self, state: dict) -> None:
+        """Install the pool state a jitted program returned (the old
+        buffers were donated into it)."""
+        self.k = state["k"]
+        self.v = state["v"]
+        self.k_scale = state.get("k_scale")
+        self.v_scale = state.get("v_scale")
+
+    def set_cursor(self, slot: int, value: int) -> None:
+        """Admission-boundary cursor write (prefill lands a request)."""
+        import jax.numpy as jnp
+
+        self.cursors = self.cursors.at[slot].set(jnp.int32(value))
+
+    def advance(self, live_mask) -> None:
+        """Unfused (K=1) path: advance live slots' cursors by one after
+        a decode dispatch. Fused programs advance cursors in-program."""
+        import jax.numpy as jnp
+
+        self.cursors = self.cursors + jnp.asarray(
+            np.asarray(live_mask, np.int32))
 
     @property
     def nbytes(self) -> int:
-        """Device footprint of the pool pair (capacity planning: the
-        serving analogue of the epoch cache's HBM budget)."""
-        return int(self.k.nbytes) + int(self.v.nbytes)
+        """Device footprint of the pool state (capacity planning: the
+        serving analogue of the epoch cache's HBM budget). Includes the
+        int8 scale sidecars."""
+        total = int(self.k.nbytes) + int(self.v.nbytes)
+        if self.k_scale is not None:
+            total += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return total
 
-    def swap(self, new_k, new_v) -> None:
-        """Install the pools a jitted program returned (the old buffers
-        were donated into it)."""
-        self.k = new_k
-        self.v = new_v
+    @property
+    def per_slot_nbytes(self) -> int:
+        """The pool bytes one concurrent request costs — what int8
+        shrinks ~4x vs float32 (max concurrency multiplies by the
+        inverse)."""
+        return self.nbytes // self.slots

@@ -3,26 +3,37 @@
 The online counterpart of the batch-oriented eval path (PR 2): a
 persistent server object that compiles its program set once, keeps all
 state device-resident (TensorFlow-paper serving/training split), and
-multiplexes S concurrent requests through ONE jitted decode step.
+multiplexes S concurrent requests through ONE jitted decode dispatch.
 
-The loop, per ``step()``:
+The loop, per ``step()`` (a step IS a fusion boundary):
 
 1. **admit** — pop queued requests into free slots; each admission runs
    the bucket-compiled prefill (``serve.prefill`` span), records TTFT,
-   and may retire immediately when ``max_new_tokens == 1``.
-2. **decode** — if any slot is live, run the batched decode program
-   once; every live slot appends a token (TPOT per slot), finished
-   requests retire and free their slots.
+   and may retire immediately when ``max_new_tokens == 1``. Admission
+   happens ONLY here: with ``fuse_steps=K`` a request arriving mid-scan
+   waits for the dispatch in flight to finish (the admission-boundary
+   trade — bounded added TTFT, in exchange for K tokens per dispatch).
+2. **decode** — if any slot is live, run ONE decode dispatch: the plain
+   single-step program (``fuse_steps=1``, the PR-10 path, bitwise), the
+   K-step fused program, or K speculative rounds when a draft is
+   configured. Every live slot appends up to its remaining tokens;
+   finished requests retire and free their slots.
 
-The host sees one [S] token readback per step — that is the decode
-loop's entire host/device chatter, and it is also the synchronization
-point the per-request results come from. Everything else (queue, slot
-table, cursors) is host bookkeeping the scheduler needs anyway.
+The host sees one token-block readback per dispatch ([S] at K=1,
+[K, S] fused, [K, S, G+2] speculative) — that is the decode loop's
+entire host/device chatter, and it is also the synchronization point
+the per-request results come from. Everything else (queue, slot table)
+is host bookkeeping the scheduler needs anyway; the per-slot cursors
+live ON DEVICE and advance in-program.
 
-Observability: queue depth / occupancy gauges, token + step counters,
-TTFT/TPOT/latency histograms (``monitor/registry``), ``serve.step`` and
-``serve.prefill`` spans (``monitor/trace`` — forwarded to the flight
-recorder when one is live, like every span).
+Observability: queue depth / occupancy gauges, token + dispatch
+counters (``serve_decode_steps_total`` counts DISPATCHES — with fusion
+one dispatch covers up to K·(G+1) tokens; ``stats()`` derives
+dispatches/token and accepted-tokens/dispatch, the fast-path headline
+metrics), speculative proposed/accepted counters, TTFT/TPOT/latency
+histograms (``monitor/registry``), ``serve.step`` and ``serve.prefill``
+spans (``monitor/trace`` — forwarded to the flight recorder when one is
+live, like every span).
 """
 
 from __future__ import annotations
@@ -35,7 +46,8 @@ import numpy as np
 from deeplearning4j_tpu.monitor import metrics, tracer
 from deeplearning4j_tpu.serving.engine import DecodeEngine
 from deeplearning4j_tpu.serving.scheduler import (
-    RequestQueue, ServeRequest, serve_max_queue, serve_slots)
+    RequestQueue, ServeRequest, serve_draft_layers, serve_fuse_steps,
+    serve_kv_dtype, serve_max_queue, serve_slots)
 
 __all__ = ["DecodeServer"]
 
@@ -54,11 +66,25 @@ class DecodeServer:
                  max_len: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
+                 fuse_steps: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
+                 draft_model=None, draft_layers: Optional[int] = None,
+                 spec_tokens: int = 3,
                  clock=time.monotonic):
+        self.fuse_steps = (fuse_steps if fuse_steps is not None
+                           else serve_fuse_steps())
+        if self.fuse_steps < 1:
+            raise ValueError(f"fuse_steps={fuse_steps} must be >= 1")
         self.engine = DecodeEngine(
             model, slots if slots is not None else serve_slots(),
             max_len=max_len, temperature=temperature, top_k=top_k,
-            buckets=buckets)
+            buckets=buckets,
+            kv_dtype=kv_dtype if kv_dtype is not None else serve_kv_dtype(),
+            draft_model=draft_model,
+            draft_layers=(draft_layers if draft_layers is not None
+                          else (0 if draft_model is not None
+                                else serve_draft_layers())),
+            spec_tokens=spec_tokens)
         self.model = model
         self.slots = self.engine.slots
         self.max_len = self.engine.max_len
@@ -69,8 +95,13 @@ class DecodeServer:
         self._last_tok = np.zeros(self.slots, np.int32)
         self._last_tok_s = np.zeros(self.slots, np.float64)
         self._keys = self._zero_keys()
+        self._draft_keys = self._zero_keys() if self.engine.spec else None
         self.finished: List[ServeRequest] = []
         self.steps = 0
+        self.decode_tokens = 0
+        self.slot_dispatches = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._reg = metrics()
 
     def _zero_keys(self):
@@ -94,10 +125,15 @@ class DecodeServer:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         total = int(prompt.shape[0]) + max_new_tokens
-        if total > self.max_len:
+        # speculative verify writes up to spec_tokens candidate K/V past
+        # the live cursor, so the slot needs that slack in the pool
+        slack = self.engine.spec_tokens if self.engine.spec else 0
+        if total + slack > self.max_len:
             raise ValueError(
-                f"prompt_len + max_new_tokens = {total} exceeds the "
-                f"server's slot capacity max_len={self.max_len}")
+                f"prompt_len + max_new_tokens = {total}"
+                + (f" (+ {slack} speculative slack)" if slack else "")
+                + f" exceeds the server's slot capacity "
+                f"max_len={self.max_len}")
         req = ServeRequest(prompt=prompt, max_new_tokens=max_new_tokens,
                            seed=seed)
         req.submit_s = self.clock()
@@ -137,6 +173,11 @@ class DecodeServer:
                                slot=slot,
                                prompt_len=int(req.prompt.shape[0])):
                 key = jax.random.PRNGKey(req.seed)
+                if self.engine.spec:
+                    # an independent per-slot draft stream (only the
+                    # sampled speculative path consumes it)
+                    self._draft_keys = self._draft_keys.at[slot].set(
+                        jax.random.fold_in(key, 0x5bec))
                 tok, key = self.engine.prefill(req.prompt, slot, key)
                 tok = int(tok)
             now = self.clock()
@@ -170,8 +211,35 @@ class DecodeServer:
                                 buckets=_LATENCY_BUCKETS
                                 ).observe(req.latency_s)
 
+    def _dispatch(self, live: List[int]):
+        """ONE decode dispatch for the current live set. Returns
+        ``(toks [K, S], counts [K, S] or None)`` as host arrays — the
+        loop's one sanctioned readback. ``counts`` is None outside the
+        speculative path (every fused row emits exactly one token)."""
+        remaining = np.zeros(self.slots, np.int32)
+        for slot in live:
+            req = self._slot_req[slot]
+            remaining[slot] = req.max_new_tokens - len(req.tokens)
+        if self.engine.spec:
+            block, self._keys, self._draft_keys = self.engine.decode_spec(
+                self._last_tok, remaining, self._keys, self._draft_keys,
+                self.fuse_steps)
+            block = np.asarray(block)            # [K, S, G+2]
+            return block[:, :, 1:], block[:, :, 0]
+        if self.fuse_steps > 1:
+            toks, self._keys = self.engine.decode_fused(
+                self._last_tok, remaining, self._keys, self.fuse_steps)
+            return np.asarray(toks), None        # [K, S]
+        toks, self._keys = self.engine.decode(
+            self._last_tok, self.engine.cache.cursors, self._keys)
+        live_mask = np.zeros(self.slots, bool)
+        live_mask[live] = True
+        self.engine.cache.advance(live_mask)
+        return np.asarray(toks)[None], None      # [1, S]
+
     def step(self) -> bool:
-        """One scheduler iteration: admit, then one batched decode step.
+        """One scheduler iteration: admit at the fusion boundary, then
+        one decode dispatch (1, K, or K speculative rounds of tokens).
         Returns False when nothing was live (the caller may idle)."""
         with tracer().span("serve.step") as sp:
             self._admit()
@@ -181,25 +249,56 @@ class DecodeServer:
                 len(live) / self.slots)
             if not live:
                 return False
-            toks, self._keys = self.engine.decode(
-                self._last_tok, self.engine.cache.cursors, self._keys)
-            toks = np.asarray(toks)
+            toks, counts = self._dispatch(live)
             now = self.clock()
             self.steps += 1
+            self.slot_dispatches += len(live)
             sp.attrs["live"] = len(live)
             self._reg.counter("serve_decode_steps_total").inc()
-            self._reg.counter("serve_tokens_total").inc(len(live))
             tpot = self._reg.histogram("serve_tpot_seconds",
                                        buckets=_LATENCY_BUCKETS)
+            emitted_total = 0
+            proposed0, accepted0 = self.spec_proposed, self.spec_accepted
             for slot in live:
                 req = self._slot_req[slot]
-                req.tokens.append(int(toks[slot]))
-                self.engine.cache.cursors[slot] += 1
-                tpot.observe(now - self._last_tok_s[slot])
-                self._last_tok[slot] = toks[slot]
+                rem = req.max_new_tokens - len(req.tokens)
+                got: List[int] = []
+                if counts is None:
+                    for r in range(min(toks.shape[0], rem)):
+                        got.append(int(toks[r, slot]))
+                else:
+                    for r in range(toks.shape[0]):
+                        c = int(counts[r, slot])
+                        if c <= 0:
+                            continue
+                        take = min(c, rem - len(got))
+                        got.extend(int(t) for t in toks[r, slot, :take])
+                        self.spec_proposed += self.engine.spec_tokens
+                        self.spec_accepted += c - 1
+                        if len(got) >= rem:
+                            break
+                req.tokens.extend(got)
+                emitted_total += len(got)
+                # with fusion the K tokens land together: spread the
+                # dispatch interval evenly so TPOT keeps one observation
+                # per token and sums to the true wall span
+                interval = (now - self._last_tok_s[slot]) / max(
+                    1, len(got))
+                for _ in got:
+                    tpot.observe(interval)
+                self._last_tok[slot] = got[-1]
                 self._last_tok_s[slot] = now
                 if len(req.tokens) >= req.max_new_tokens:
                     self._retire(slot, now)
+            self.decode_tokens += emitted_total
+            self._reg.counter("serve_tokens_total").inc(emitted_total)
+            if self.engine.spec:
+                if self.spec_proposed > proposed0:
+                    self._reg.counter("serve_spec_proposed_total").inc(
+                        self.spec_proposed - proposed0)
+                if self.spec_accepted > accepted0:
+                    self._reg.counter("serve_spec_accepted_total").inc(
+                        self.spec_accepted - accepted0)
             # re-publish after retirement: a drained server must read 0,
             # not the pre-retirement batch width
             self._reg.gauge("serve_slot_occupancy").set(self.occupancy())
@@ -218,14 +317,52 @@ class DecodeServer:
     # ------------------------------------------------------------------
     def stats(self) -> dict:
         """Artifact-ready snapshot: compile counts, pool footprint,
-        request/step totals."""
-        return {
+        request/dispatch totals, and the fast-path headline ratios
+        (dispatches/token, accepted-tokens/dispatch)."""
+        pool_bytes = self.engine.cache.nbytes
+        per_slot = self.engine.cache.per_slot_nbytes
+        if self.engine.draft_cache is not None:
+            pool_bytes += self.engine.draft_cache.nbytes
+            per_slot += self.engine.draft_cache.per_slot_nbytes
+        out = {
             "slots": self.slots,
             "max_len": self.max_len,
             "queue_depth": len(self.queue),
             "occupancy": self.occupancy(),
             "steps": self.steps,
             "finished": len(self.finished),
-            "kv_pool_bytes": self.engine.cache.nbytes,
+            "fuse_steps": self.fuse_steps,
+            "kv_dtype": self.engine.kv_dtype,
+            "kv_pool_bytes": pool_bytes,
+            # what one concurrent request costs in pool HBM — includes
+            # the draft pool's share when speculative (kv_per_slot_bytes
+            # * slots == kv_pool_bytes holds in every configuration)
+            "kv_per_slot_bytes": per_slot,
+            "decode_dispatches": self.steps,
+            "decode_tokens": self.decode_tokens,
+            "dispatches_per_token": (
+                round(self.steps / self.decode_tokens, 4)
+                if self.decode_tokens else None),
+            # tokens one dispatch yields across the whole batch (slot
+            # batching amortizes on top of fusion/speculation) ...
+            "accepted_tokens_per_dispatch": (
+                round(self.decode_tokens / self.steps, 4)
+                if self.steps else None),
+            # ... vs per live slot: exactly 1.0 on the unfused
+            # non-speculative path, > 1 ONLY through fusion (up to K)
+            # or accepted speculation (up to K*(spec_tokens+1)) — the
+            # isolated fast-path signal
+            "tokens_per_slot_dispatch": (
+                round(self.decode_tokens / self.slot_dispatches, 4)
+                if self.slot_dispatches else None),
+            "speculative": self.engine.spec,
             "compiles": self.engine.compile_counts(),
         }
+        if self.engine.spec:
+            out["spec_tokens"] = self.engine.spec_tokens
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_accept_rate"] = (
+                round(self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else None)
+        return out

@@ -30,8 +30,9 @@ from deeplearning4j_tpu.perf.bucketing import (
     DEFAULT_PROMPT_BUCKETS, pad_prompt, prompt_bucket)
 from deeplearning4j_tpu.serving import (
     DecodeServer, ServeQueueFull, SlotKVCache, compile_cache_stats,
-    ensure_compile_cache, poisson_schedule, run_open_loop,
-    serve_max_queue, serve_slots)
+    ensure_compile_cache, kv_pool_nbytes, max_slots_in_budget,
+    poisson_schedule, run_open_loop, serve_draft_layers,
+    serve_fuse_steps, serve_max_queue, serve_slots)
 from deeplearning4j_tpu.serving import compile_cache as compile_cache_mod
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -489,3 +490,379 @@ class TestBenchReportDirections:
         assert payload["directions"]["serve_tokens_per_sec"] == "higher"
         row = payload["rounds"][0]
         assert row["serve_p50_latency_ms"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# fused multi-token decode: ("decode_fused", S, K)
+# ---------------------------------------------------------------------------
+class TestFusedDecode:
+    @pytest.mark.parametrize("pos_encoding", ["learned", "rope"])
+    def test_fused_greedy_token_identical(self, rng, pos_encoding):
+        """K=4 fused decode over 2 slots with recycling across fusion
+        boundaries — token-for-token identical to the K=1 path (which
+        PR 10 pinned to ``generate``)."""
+        lm = _lm(pos_encoding)
+        prompts = _prompts(rng, (5, 11, 23))
+        max_new = [7, 4, 9]
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, max_new)]
+        srv = DecodeServer(lm, slots=2, max_len=96, fuse_steps=4)
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, max_new)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert np.array_equal(req.output, ref)
+
+    def test_fused_dispatch_count_is_ceil(self, rng):
+        """The acceptance invariant: one request generating N tokens at
+        fuse_steps=K takes exactly ceil((N - prefill_token)/K) decode
+        dispatches, counter-asserted."""
+        lm = _lm()
+        p = _prompts(rng, (6,))[0]
+        for k, max_new in ((4, 10), (3, 10), (5, 6), (4, 5)):
+            srv = DecodeServer(lm, slots=1, max_len=96, fuse_steps=k)
+            reg = metrics()
+            d0 = reg.counter("serve_decode_steps_total").value()
+            req = srv.submit(p, max_new)
+            srv.drain()
+            want = -(-(max_new - 1) // k)   # ceil; 1 token from prefill
+            assert srv.steps == want, (k, max_new, srv.steps)
+            assert reg.counter("serve_decode_steps_total").value() \
+                == d0 + want
+            assert np.array_equal(
+                req.output, np.asarray(lm.generate(p[None], max_new))[0])
+
+    def test_fused_sampled_matches_single_step(self, rng):
+        """Per-slot RNG splits move in-program: the K=3 fused stream
+        emits the same sampled tokens as ``generate(seed=s)``."""
+        lm = _lm(num_kv_heads=4)
+        prompts = _prompts(rng, (5, 11))
+        refs = [np.asarray(lm.generate(
+            p[None], 6, temperature=0.7, top_k=13, seed=s))[0]
+            for s, p in enumerate(prompts)]
+        srv = DecodeServer(lm, slots=2, max_len=96, fuse_steps=3,
+                           temperature=0.7, top_k=13)
+        reqs = [srv.submit(p, 6, seed=s) for s, p in enumerate(prompts)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+
+    def test_ragged_retirement_mid_scan(self, rng):
+        """A short request (2 tokens) rides a K=4 scan beside a long one
+        (9): the short slot self-freezes mid-scan (its remaining hits 0)
+        and both streams stay token-exact through the recycle that
+        follows."""
+        lm = _lm()
+        prompts = _prompts(rng, (4, 8, 6))
+        max_new = [2, 9, 5]
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, max_new)]
+        srv = DecodeServer(lm, slots=2, max_len=96, fuse_steps=4)
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, max_new)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert len(req.tokens) == req.max_new_tokens
+            assert np.array_equal(req.output, ref)
+
+    def test_fuse_steps_one_is_pr10_bitwise(self, rng):
+        """``DL4J_SERVE_FUSE_STEPS=1`` (the default) runs the identical
+        PR-10 single-step program — same ("decode", S) cache key, same
+        per-step dispatch cadence, same tokens."""
+        lm = _lm()
+        prompts = _prompts(rng, (5, 11))
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, (6, 4))]
+        srv = DecodeServer(lm, slots=2, max_len=96)
+        assert srv.fuse_steps == 1
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, (6, 4))]
+        srv.drain()
+        assert ("decode", 2) in srv.engine._programs
+        assert not any(s[0] in ("decode_fused", "decode_spec")
+                       for s in srv.engine._programs)
+        assert srv.steps == 5   # max(6,4)-1: one dispatch per token
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+        assert srv.stats()["tokens_per_slot_dispatch"] == 1.0
+
+    def test_fused_env_flag(self, rng, monkeypatch):
+        monkeypatch.setenv("DL4J_SERVE_FUSE_STEPS", "4")
+        assert serve_fuse_steps() == 4
+        lm = _lm()
+        srv = DecodeServer(lm, slots=1, max_len=96)
+        assert srv.fuse_steps == 4
+        monkeypatch.setenv("DL4J_SERVE_FUSE_STEPS", "bogus")
+        assert serve_fuse_steps() == 1
+        monkeypatch.delenv("DL4J_SERVE_FUSE_STEPS")
+        assert serve_fuse_steps() == 1
+
+    def test_fused_compile_flat_after_warmup(self, rng):
+        """The fused program joins the bounded program set: a second
+        ragged wave at the same (S, K) adds ZERO programs."""
+        lm = _lm()
+        srv = DecodeServer(lm, slots=3, max_len=96, fuse_steps=4)
+        for p, m in zip(_prompts(rng, (5, 12, 30)), (4, 3, 5)):
+            srv.submit(p, m)
+        srv.drain()
+        warm = srv.engine.program_builds
+        assert ("decode_fused", 3, 4) in srv.engine._programs
+        for p, m in zip(_prompts(rng, (7, 16, 25, 9)), (2, 5, 3, 4)):
+            srv.submit(p, m)
+        srv.drain()
+        assert srv.engine.program_builds == warm
+
+    def test_admission_waits_for_fusion_boundary(self, rng):
+        """With fuse_steps=K a request submitted while a dispatch is in
+        flight joins at the next step() — the admission-boundary
+        semantics (queue drains only through _admit)."""
+        lm = _lm()
+        srv = DecodeServer(lm, slots=2, max_len=96, fuse_steps=4)
+        srv.submit(_prompts(rng, (5,))[0], 9)
+        srv.step()                     # dispatch in flight for req 1
+        late = srv.submit(_prompts(rng, (7,))[0], 3)
+        assert late.state == "queued"  # mid-flight: not admitted
+        srv.step()                     # boundary: admitted + decoded
+        assert late.state in ("running", "finished")
+        srv.drain()
+        assert np.array_equal(
+            late.output,
+            np.asarray(lm.generate(late.prompt[None], 3))[0])
+
+
+# ---------------------------------------------------------------------------
+# quantized KV pool (DL4J_SERVE_KV_DTYPE)
+# ---------------------------------------------------------------------------
+class TestQuantizedKV:
+    def test_int8_pool_shrinks_4x(self):
+        lm = _lm()
+        f32 = SlotKVCache(lm, slots=4, max_len=96, kv_dtype="float32")
+        i8 = SlotKVCache(lm, slots=4, max_len=96, kv_dtype="int8")
+        ratio = f32.per_slot_nbytes / i8.per_slot_nbytes
+        assert 3.5 < ratio <= 4.0, ratio
+        assert kv_pool_nbytes(lm, 4, 96, "int8") == i8.nbytes
+        assert kv_pool_nbytes(lm, 4, 96, "float32") == f32.nbytes
+
+    def test_validate_cache_budget_prices_the_quantized_pool(self):
+        """PR 8's budget validator sees the pool + scale sidecars the
+        runtime actually allocated: predicted nbytes == measured device
+        bytes, and the int8 pool measures ~4x under float32."""
+        from deeplearning4j_tpu.monitor.memory import validate_cache_budget
+        lm = _lm()
+        out = {}
+        for dt in ("float32", "int8"):
+            cache = SlotKVCache(lm, slots=4, max_len=96, kv_dtype=dt)
+            v = validate_cache_budget(cache)
+            assert v["within_tolerance"], v
+            assert v["predicted_per_shard_bytes"] \
+                == v["measured_per_device_bytes"] == cache.nbytes
+            out[dt] = v["measured_per_device_bytes"]
+        assert 3.5 < out["float32"] / out["int8"] <= 4.0
+
+    def test_max_slots_in_budget_multiplies(self):
+        lm = _lm()
+        budget = 64 * 1024 * 1024
+        n_f32 = max_slots_in_budget(lm, 96, budget, "float32")
+        n_i8 = max_slots_in_budget(lm, 96, budget, "int8")
+        assert n_i8 > 3 * n_f32
+        assert max_slots_in_budget(lm, 96, 0, "int8") == 0
+
+    def test_kv_dtype_validation_and_env(self, monkeypatch):
+        lm = _lm()
+        with pytest.raises(ValueError):
+            SlotKVCache(lm, slots=1, kv_dtype="int4")
+        monkeypatch.setenv("DL4J_SERVE_KV_DTYPE", "bf16")
+        assert SlotKVCache(lm, slots=1).kv_dtype == "bfloat16"
+        monkeypatch.delenv("DL4J_SERVE_KV_DTYPE")
+        # unset: the pool stays in the model's compute dtype (the
+        # pre-quantization default, bitwise)
+        assert SlotKVCache(lm, slots=1).kv_dtype == "float32"
+
+    def test_int8_greedy_token_parity(self, rng):
+        """End-to-end: the int8-quantized pool reproduces the
+        full-precision greedy stream on the small test model (pinned
+        prompts — int8 is lossy by design; the logit-error test bounds
+        how lossy)."""
+        lm = _lm()
+        prompts = _prompts(rng, (5, 17))
+        max_new = [7, 6]
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, max_new)]
+        srv = DecodeServer(lm, slots=2, max_len=96, kv_dtype="int8")
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, max_new)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+        assert srv.stats()["kv_dtype"] == "int8"
+
+    def test_int8_fused_matches_single_step(self, rng):
+        """Quantization composes with fusion: K=3 int8 == K=1 int8
+        token-for-token (the requant/scatter sequence per slot is the
+        same op chain either way)."""
+        lm = _lm("rope")
+        prompts = _prompts(rng, (3, 9, 17, 5))
+        max_new = [5, 2, 6, 8]
+        a = DecodeServer(lm, slots=2, max_len=96, kv_dtype="int8")
+        b = DecodeServer(lm, slots=2, max_len=96, kv_dtype="int8",
+                         fuse_steps=3)
+        ra = [a.submit(p, m) for p, m in zip(prompts, max_new)]
+        a.drain()
+        rb = [b.submit(p, m) for p, m in zip(prompts, max_new)]
+        b.drain()
+        for x, y in zip(ra, rb):
+            assert np.array_equal(x.output, y.output)
+
+    def test_int8_roundtrip_logit_error_bound(self):
+        """The quantization error contract: a dequantized K/V element
+        sits within absmax/127 of the original (half a quantum after
+        rounding), including after a requantizing scale growth."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.serving.kv_cache import (
+            dequant_slab, requant_write_slab)
+
+        rng = np.random.default_rng(7)
+        s_, t_, h_, d_ = 3, 8, 2, 4
+        slab = jnp.zeros((s_, t_, h_, d_), jnp.int8)
+        scale = jnp.zeros((s_, h_), jnp.float32)
+        rows = jnp.arange(s_)
+        vals1 = jnp.asarray(rng.normal(size=(s_, 4, h_, d_)), jnp.float32)
+        pos1 = jnp.tile(jnp.arange(4)[None], (s_, 1))
+        slab, scale = requant_write_slab(slab, scale, vals1, rows, pos1)
+        # second write with LARGER values: forces a requantization of
+        # the first write's entries under the grown scale
+        vals2 = 3.0 * jnp.asarray(
+            rng.normal(size=(s_, 4, h_, d_)), jnp.float32)
+        pos2 = pos1 + 4
+        slab, scale = requant_write_slab(slab, scale, vals2, rows, pos2)
+        deq = np.asarray(dequant_slab(slab, scale, jnp.float32))
+        bound = np.asarray(scale)[:, None, :, None] / 127.0 + 1e-7
+        err1 = np.abs(deq[:, :4] - np.asarray(vals1))
+        err2 = np.abs(deq[:, 4:] - np.asarray(vals2))
+        # the requantized first write pays one extra rounding: 2 quanta
+        assert (err1 <= 2 * bound).all(), err1.max()
+        assert (err2 <= bound).all(), err2.max()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (draft + verify inside the fused program)
+# ---------------------------------------------------------------------------
+class TestSpeculativeDecode:
+    def test_full_self_draft_accepts_everything(self, rng):
+        """draft_layers == num_layers makes the draft the target: every
+        proposal verifies, tokens/slot-dispatch hits spec_tokens + 1,
+        and the stream is the target's greedy stream."""
+        lm = _lm()
+        p = _prompts(rng, (5,))[0]
+        srv = DecodeServer(lm, slots=1, max_len=96, draft_layers=2,
+                           spec_tokens=3)
+        req = srv.submit(p, 13)     # 12 decode tokens = 3 full rounds
+        srv.drain()
+        assert np.array_equal(
+            req.output, np.asarray(lm.generate(p[None], 13))[0])
+        st = srv.stats()
+        assert st["spec_accept_rate"] == 1.0
+        assert st["tokens_per_slot_dispatch"] == 4.0
+        assert srv.steps == 3
+
+    @pytest.mark.parametrize("pos_encoding", ["learned", "rope"])
+    def test_shallow_draft_greedy_token_identity(self, rng, pos_encoding):
+        """The speculative contract: whatever the draft proposes (here a
+        1-of-2-layer self-draft with a low accept rate), the emitted
+        stream is EXACTLY the target model's greedy stream — acceptance
+        only changes how many dispatches it takes."""
+        lm = _lm(pos_encoding)
+        prompts = _prompts(rng, (5, 11, 23))
+        max_new = [7, 4, 9]
+        refs = [np.asarray(lm.generate(p[None], m))[0]
+                for p, m in zip(prompts, max_new)]
+        srv = DecodeServer(lm, slots=2, max_len=96, draft_layers=1,
+                           spec_tokens=3)
+        reqs = [srv.submit(p, m) for p, m in zip(prompts, max_new)]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+        st = srv.stats()
+        assert st["speculative"] and st["spec_proposed"] > 0
+
+    def test_provided_draft_model(self, rng):
+        """An independently seeded draft TransformerLM rides the same
+        slot machinery (its own pool) and preserves target greedy
+        token identity."""
+        lm = _lm("rope")
+        draft = _lm("rope", num_layers=1, seed=9)
+        p = _prompts(rng, (9,))[0]
+        ref = np.asarray(lm.generate(p[None], 8))[0]
+        srv = DecodeServer(lm, slots=2, max_len=96, draft_model=draft,
+                           spec_tokens=2)
+        req = srv.submit(p, 8)
+        srv.drain()
+        assert np.array_equal(req.output, ref)
+
+    def test_spec_composes_with_fuse_steps(self, rng):
+        """K rounds per dispatch: fuse_steps=2 x spec_tokens=2 emits up
+        to 6 tokens per dispatch and stays target-greedy-exact."""
+        lm = _lm()
+        prompts = _prompts(rng, (3, 9, 17))
+        refs = [np.asarray(lm.generate(p[None], 9))[0] for p in prompts]
+        srv = DecodeServer(lm, slots=2, max_len=96, draft_layers=2,
+                           spec_tokens=2, fuse_steps=2)
+        reqs = [srv.submit(p, 9) for p in prompts]
+        srv.drain()
+        for req, ref in zip(reqs, refs):
+            assert np.array_equal(req.output, ref)
+        assert srv.stats()["tokens_per_slot_dispatch"] > 1.0
+
+    def test_sampled_spec_matches_target_distribution(self):
+        """Accept/resample correctness, statistically: the marginal of
+        a decode-phase token under speculative sampling stays within a
+        total-variation bound of the vanilla sampled server's (exact
+        per-token identity is NOT expected — the RNG consumption
+        differs; the DISTRIBUTION must not)."""
+        V = 13
+        lm = TransformerLM(vocab_size=V, d_model=16, num_heads=2,
+                           num_layers=2, max_len=32, seed=5).init()
+        prompt = np.array([1, 2, 3], np.int32)
+        n = 300
+
+        def freqs(**kw):
+            srv = DecodeServer(lm, slots=1, max_len=32, temperature=0.9,
+                               **kw)
+            c = np.zeros(V)
+            for s in range(n):
+                req = srv.submit(prompt, 4, seed=s)
+                srv.drain()
+                c[req.tokens[2]] += 1
+            return c / n
+
+        ref = freqs()
+        spec = freqs(draft_layers=1, spec_tokens=2)
+        tv = 0.5 * np.abs(ref - spec).sum()
+        assert tv < 0.15, tv
+
+    def test_env_flag_and_validation(self, rng, monkeypatch):
+        monkeypatch.setenv("DL4J_SERVE_DRAFT_LAYERS", "1")
+        assert serve_draft_layers() == 1
+        lm = _lm()
+        srv = DecodeServer(lm, slots=1, max_len=96)
+        assert srv.engine.spec
+        assert srv.engine.draft_model.num_layers == 1
+        monkeypatch.delenv("DL4J_SERVE_DRAFT_LAYERS")
+        with pytest.raises(ValueError):
+            DecodeServer(lm, slots=1, max_len=96, draft_layers=3)
+        with pytest.raises(ValueError):
+            DecodeServer(lm, slots=1, max_len=96, draft_layers=1,
+                         spec_tokens=0)
+        with pytest.raises(ValueError):
+            # draft vocab mismatch
+            DecodeServer(lm, slots=1, max_len=96,
+                         draft_model=_lm(vocab_size=32))
+
+    def test_spec_capacity_needs_verify_slack(self, rng):
+        """The verify forward writes spec_tokens candidates past the
+        live cursor: submit() reserves that slack against max_len."""
+        lm = _lm()
+        srv = DecodeServer(lm, slots=1, max_len=32, draft_layers=1,
+                           spec_tokens=4)
+        with pytest.raises(ValueError):
+            srv.submit(_prompts(rng, (20,))[0], 9)   # 29 + 4 > 32
+        req = srv.submit(_prompts(rng, (20,))[0], 8)  # 28 + 4 == 32
+        srv.drain()
+        assert len(req.tokens) == 8

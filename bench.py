@@ -48,6 +48,13 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
     Poisson stream — p50/p99 latency, TTFT/TPOT, tokens/sec, slot
     occupancy, and compile-count flatness after warmup (plus the
     persisted XLA compilation cache's on-disk stats)
+  - serve_fleet: M in-process DecodeServer replicas behind the fleet
+    router, the SAME Poisson stream replayed at each fleet size on
+    per-replica virtual clocks (real measured dispatch costs booked on
+    chip-per-replica timelines) — aggregate tokens/sec scaling 1->2->4,
+    p50/p99/TTFT vs the single-replica baseline, routing balance, and
+    a failover measurement (one replica killed mid-stream: requeued
+    requests must all complete, recovery time reported)
 
 MFU = achieved / peak, peak stated per chip (v5e: 197 TFLOP/s bf16).
 Model FLOPs come from the COMPILED program's ``cost_analysis()`` when the
@@ -996,6 +1003,157 @@ def _bench_serve_run():
             "fast_path": sweep}
 
 
+def bench_serve_fleet():
+    """Serve fleet: M in-process replicas behind the routing frontend,
+    the same Poisson stream replayed per fleet size. One bench host has
+    one backend, so in-process replicas time-slice it — the driver books
+    each replica's REAL measured dispatch costs on its own virtual
+    timeline (the chip-per-replica deployment model); the scaling number
+    therefore measures the fleet layer (routing balance, queue spill,
+    admission batching), not host parallelism the machine doesn't have.
+    Alongside scaling: p50/p99/TTFT vs the single-replica baseline,
+    per-replica busy-time balance, and a failover round — one replica
+    killed mid-stream, controller eviction, requeue-with-re-prefill on
+    the survivor — reporting recovery time and asserting zero lost
+    requests + greedy token identity for every rerouted request."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import poisson_schedule
+    from deeplearning4j_tpu.serving.fleet import (
+        FleetController, FleetLoadDriver, FleetRouter, ServeReplica)
+
+    lm = TransformerLM(vocab_size=512, d_model=128, num_heads=8,
+                       num_kv_heads=4, num_layers=2, max_len=512,
+                       seed=7, dtype_policy="bf16",
+                       pos_encoding="rope").init()
+    prompt_lens = (8, 16, 24)
+
+    def build_replicas(n):
+        reps = [ServeReplica(f"r{i}", lm, slots=8, max_len=256,
+                             fuse_steps=4) for i in range(n)]
+        for r in reps:
+            # warm every prompt-ladder rung + the fused decode program
+            # on the main thread, outside the measured virtual replay
+            for plen in prompt_lens:
+                r.server.submit(np.arange(1, plen + 1, dtype=np.int32), 2)
+            r.server.drain()
+            r.server.finished.clear()
+            r._finished_seen = 0
+        return reps
+
+    def schedule(seed=5):
+        # saturating on purpose (arrival span << 1-replica busy time):
+        # an arrival-limited stream would show flat tokens/sec at every
+        # fleet size and measure nothing
+        return poisson_schedule(48, rate_rps=2000.0, vocab_size=512,
+                                prompt_lens=prompt_lens,
+                                max_new_tokens=(16,), seed=seed)
+
+    def run_fleet_once(n):
+        reps = build_replicas(n)
+        router = FleetRouter(reps)
+        driver = FleetLoadDriver(
+            router, FleetController(router, None, evict_timeout_s=5.0))
+        report = driver.run(schedule())
+        s = report.summary()
+        busy = driver.busy_seconds()
+        vals = list(busy.values())
+        s["replicas"] = n
+        s["busy_seconds"] = {k: round(v, 4) for k, v in busy.items()}
+        # balance = min/max busy time: 1.0 is a perfectly even split
+        # (busy_seconds seeds every replica, so a starved one reads 0)
+        s["balance"] = (round(min(vals) / max(vals), 4)
+                        if len(vals) > 1 and max(vals) > 0 else 1.0)
+        s["dispatches"] = {rid: sum(1 for r, _, _ in driver.dispatch_log
+                                    if r == rid) for rid in busy}
+        return s
+
+    def run_fleet(n, rounds=2):
+        # real measured dispatch costs carry single-run wall noise
+        # (~10-20% on a busy host); best-of-N is the capability
+        # estimate, same-schedule replay keeps it apples-to-apples
+        s = max((run_fleet_once(n) for _ in range(rounds)),
+                key=lambda r: r["tokens_per_sec"])
+        _log(f"serve_fleet[{n}r]: {s['tokens_per_sec']:,.0f} tok/s, "
+             f"p50 {s['p50_latency_ms']} ms, TTFT p50 "
+             f"{s['ttft_p50_ms']} ms, balance {s['balance']} "
+             f"(best of {rounds})")
+        return s
+
+    fleet = {n: run_fleet(n) for n in (1, 2, 4)}
+    base = fleet[1]["tokens_per_sec"]
+    scaling = {n: round(fleet[n]["tokens_per_sec"] / base, 4)
+               for n in fleet}
+    _log(f"serve_fleet: tokens/sec scaling vs 1 replica: "
+         + ", ".join(f"{n}r={scaling[n]}" for n in sorted(scaling)))
+    # the clock model books REAL measured dispatch costs: scaling above
+    # the replica count is impossible from routing alone and means the
+    # host was contended during one of the runs — flag it rather than
+    # report an inflated win as clean
+    noise_flag = any(scaling[n] > n * 1.1 for n in scaling)
+    if noise_flag:
+        _log("serve_fleet: WARNING — superlinear scaling measured; the "
+             "baseline run's dispatch costs were likely inflated by "
+             "host contention (rerun on an idle machine)")
+
+    # ---- failover: kill one of two replicas mid-stream ---------------
+    reps = build_replicas(2)
+    router = FleetRouter(reps)
+    controller = FleetController(router, None, evict_timeout_s=5.0)
+    driver = FleetLoadDriver(router, controller)
+    report = driver.run(schedule(seed=6), kill_at_s=0.08,
+                        kill_replica="r0")
+    lost = sum(1 for fr in router.requests if not fr.finished)
+    # greedy token identity across the failover: every request's final
+    # stream must equal the model's own unassisted greedy decode
+    diverged = 0
+    for fr in router.requests:
+        ref = np.asarray(lm.generate(fr.prompt[None],
+                                     fr.max_new_tokens))[0]
+        if not np.array_equal(fr.output, ref):
+            diverged += 1
+    failover_s = (None if driver.failover_done_s is None
+                  or driver.kill_time_s is None
+                  else round(driver.failover_done_s
+                             - driver.kill_time_s, 4))
+    evic = controller.eviction_log[0] if controller.eviction_log else {}
+    requeued = evic.get("failover", {}).get("victims", 0)
+    _log(f"serve_fleet: failover — {requeued} requests requeued, "
+         f"{lost} lost, {diverged} diverged, recovery "
+         f"{failover_s}s past the kill (detection floor is "
+         f"DL4J_SERVE_EVICT_S in deployment; the bench evicts at the "
+         f"kill instant)")
+    assert lost == 0, f"failover lost {lost} request(s)"
+    assert diverged == 0, (
+        f"failover broke greedy token identity on {diverged} request(s)")
+
+    return {
+        "fleet": {str(n): fleet[n] for n in fleet},
+        "fleet_tokens_per_sec": fleet[2]["tokens_per_sec"],
+        "single_tokens_per_sec": base,
+        "tokens_per_sec_scaling_2r": scaling[2],
+        "tokens_per_sec_scaling_4r": scaling[4],
+        "scaling_2r_target_met": bool(scaling[2] >= 1.8),
+        "scaling_noise_flag": noise_flag,
+        "p50_latency_ms_2r": fleet[2]["p50_latency_ms"],
+        "p99_latency_ms_2r": fleet[2]["p99_latency_ms"],
+        "ttft_p50_ms_2r": fleet[2]["ttft_p50_ms"],
+        "balance_2r": fleet[2]["balance"],
+        "failover": {
+            "requeued": requeued,
+            "lost_requests": lost,
+            "diverged_requests": diverged,
+            "failover_complete_s": failover_s,
+            "finished": report.summary()["finished"],
+            "eviction_reason": evic.get("reason"),
+        },
+        "failover_complete_s": failover_s,
+        "clock_model": "per-replica virtual timelines over real "
+                       "measured dispatch costs (chip-per-replica)",
+    }
+
+
 def bench_eval():
     """Inference/eval path: device-resident confusion accumulation vs the
     host path (per-batch logit readback) on a stream of ragged batches.
@@ -1476,6 +1634,7 @@ def main() -> None:
                 ("epoch", bench_epoch),
                 ("dp_epoch", bench_dp_epoch),
                 ("serve", bench_serve),
+                ("serve_fleet", bench_serve_fleet),
                 ("guard", bench_guard),
                 ("telemetry", bench_telemetry),
                 ("flight", bench_flight)]

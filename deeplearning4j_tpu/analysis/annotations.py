@@ -86,4 +86,11 @@ HOT_PATH_REGISTRY = frozenset({
     "_serve_spec_impl",
     "_serve_verify_impl",
     "_decode_step_body",
+    # serving/fleet/handoff.py — the prefill/decode-split slot movers:
+    # pure gather/scatter programs over the pool. The handoff's host
+    # readback is once-per-request at the prefill boundary (outside
+    # these bodies, in export_slot) — a sync INSIDE them would ride
+    # along into every compiled decode-pool program that reuses them.
+    "_slot_export_impl",
+    "_slot_import_impl",
 })

@@ -56,6 +56,15 @@ class _Instrument:
     def _zero(self):
         return 0.0
 
+    def remove(self, **labels) -> bool:
+        """Drop one labeled series (e.g. an evicted fleet replica's
+        per-replica gauge): a source that no longer exists must stop
+        reporting as current, or dashboards and eviction audits read a
+        corpse's last value as live. Returns whether the series
+        existed."""
+        with self._lock:
+            return self._series.pop(_label_key(labels), None) is not None
+
     def labels(self) -> List[dict]:
         with self._lock:
             return [dict(k) for k in self._series]

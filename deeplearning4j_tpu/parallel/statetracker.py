@@ -108,11 +108,17 @@ class StateTracker:
     # --- heartbeats / liveness ---
     def heartbeat(self, worker_id: str,
                   metrics: Optional[Dict[str, Any]] = None) -> None:
-        """Post liveness; ``metrics`` (optional) is a COMPACT payload —
-        step time, goodput, last-chunk loss — the master's fleet view
-        aggregates. Payload-less beats remain fully supported (and are
-        the cheap path); backends that predate the parameter still
-        satisfy the liveness half of the contract."""
+        """Post liveness; ``metrics`` (optional) is a COMPACT payload
+        the master's fleet view aggregates. Two payload schemas ride
+        this channel today (free-form dicts by contract; these are the
+        keys the aggregators look for): training workers post
+        ``{step_s, jobs, last_loss, goodput_pct}``
+        (``DistributedTrainer``'s fleet tick), serve replicas post
+        ``{role, occupancy, queue_depth, free_slots, ttft_p50, tpot_s,
+        tokens_per_sec}`` (``serving/fleet``'s router + controller).
+        Payload-less beats remain fully supported (and are the cheap
+        path); backends that predate the parameter still satisfy the
+        liveness half of the contract."""
         raise NotImplementedError
 
     def last_heartbeat(self, worker_id: str) -> Optional[float]:

@@ -72,6 +72,36 @@ class NetworkWorkPerformer(WorkerPerformer):
         self.network.set_flat_params(np.asarray(params))
 
 
+def update_straggler_flags(samples: Dict[str, float], flagged: set,
+                           ratio: float, *, id_label: str,
+                           value_key: str, counter_name: str,
+                           event_name: str,
+                           min_reporting: int = 3) -> Optional[float]:
+    """Shared outlier rule for fleet views (training master tick AND the
+    serve-fleet controller): a member whose sample exceeds ``ratio`` x
+    the fleet median gets flagged — with the evidence (value, median,
+    ratio) on the timeline — and un-flagged on recovery. Requires at
+    least ``min_reporting`` members so one slow pair cannot nominate
+    each other. Mutates ``flagged`` in place; returns the median used
+    (None when below the reporting floor)."""
+    if len(samples) < min_reporting:
+        return None
+    median = statistics.median(samples.values())
+    for member, value in samples.items():
+        slow = median > 0 and value > ratio * median
+        if slow and member not in flagged:
+            flagged.add(member)
+            record_counter(counter_name, **{id_label: member})
+            tracer().event(event_name,
+                           **{id_label: member,
+                              value_key: round(value, 4),
+                              "median_s": round(median, 4),
+                              "ratio": ratio})
+        elif not slow:
+            flagged.discard(member)
+    return median
+
+
 def average_aggregator(updates: Sequence[np.ndarray]) -> np.ndarray:
     """INDArrayAggregator: element-wise mean (parameter averaging)."""
     if not updates:
@@ -320,19 +350,11 @@ class DistributedTrainer:
                           ).set(float(m["last_loss"]), worker=w)
         steps = {w: float(m["step_s"]) for w, m in fleet.items()
                  if isinstance(m.get("step_s"), (int, float))}
-        if len(steps) >= 3:
-            median = statistics.median(steps.values())
-            for w, s in steps.items():
-                slow = median > 0 and s > self.straggler_ratio * median
-                if slow and w not in self.stragglers:
-                    self.stragglers.add(w)
-                    record_counter("fleet_stragglers_total", worker=w)
-                    tracer().event("fleet.straggler", worker=w,
-                                   step_s=round(s, 4),
-                                   median_s=round(median, 4),
-                                   ratio=self.straggler_ratio)
-                elif not slow:
-                    self.stragglers.discard(w)
+        update_straggler_flags(steps, self.stragglers,
+                               self.straggler_ratio, id_label="worker",
+                               value_key="step_s",
+                               counter_name="fleet_stragglers_total",
+                               event_name="fleet.straggler")
         reg.gauge("fleet_workers", "workers with live heartbeats"
                   ).set(float(len(self.tracker.workers())))
         reg.gauge("fleet_stragglers",

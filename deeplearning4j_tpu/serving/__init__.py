@@ -28,7 +28,13 @@ serving/training split applied to the fused-program framework:
   compilation cache (``DL4J_COMPILE_CACHE_DIR``) so fleet cold-start
   replays compiles from disk.
 - :mod:`~deeplearning4j_tpu.serving.loadgen` — open-loop Poisson load
-  generator + p50/p99/TTFT/TPOT report (the ``serve`` bench section).
+  generator + p50/p99/TTFT/TPOT report with per-drop timestamps (the
+  ``serve`` bench section).
+- :mod:`~deeplearning4j_tpu.serving.fleet` — the multi-replica serve
+  fleet (imported explicitly, not re-exported here): replica workers
+  under the cluster layer's heartbeat channel, a least-loaded routing
+  frontend with failover requeue, the controller's master tick, and
+  the ``DL4J_SERVE_ROLE`` prefill/decode split.
 
 See ``docs/inference.md`` §Serving for the architecture and the slot
 lifecycle, ``docs/observability.md`` for the serve metric/span taxonomy.
@@ -47,13 +53,17 @@ from deeplearning4j_tpu.serving.kv_cache import (  # noqa: F401
 )
 from deeplearning4j_tpu.serving.engine import DecodeEngine  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
+    AdmissionVerdict,
     RequestQueue,
     ServeQueueFull,
     ServeRequest,
     serve_draft_layers,
+    serve_evict_s,
     serve_fuse_steps,
     serve_kv_dtype,
     serve_max_queue,
+    serve_replicas,
+    serve_role,
     serve_slots,
 )
 from deeplearning4j_tpu.serving.server import DecodeServer  # noqa: F401
@@ -65,11 +75,12 @@ from deeplearning4j_tpu.serving.loadgen import (  # noqa: F401
 )
 
 __all__ = [
-    "Arrival", "DecodeEngine", "DecodeServer", "LoadReport",
-    "RequestQueue", "ServeQueueFull", "ServeRequest", "SlotKVCache",
-    "compile_cache_dir", "compile_cache_stats", "ensure_compile_cache",
-    "kv_pool_nbytes", "max_slots_in_budget", "poisson_schedule",
-    "resolve_kv_dtype", "run_open_loop", "serve_draft_layers",
-    "serve_fuse_steps", "serve_kv_dtype", "serve_max_queue",
+    "AdmissionVerdict", "Arrival", "DecodeEngine", "DecodeServer",
+    "LoadReport", "RequestQueue", "ServeQueueFull", "ServeRequest",
+    "SlotKVCache", "compile_cache_dir", "compile_cache_stats",
+    "ensure_compile_cache", "kv_pool_nbytes", "max_slots_in_budget",
+    "poisson_schedule", "resolve_kv_dtype", "run_open_loop",
+    "serve_draft_layers", "serve_evict_s", "serve_fuse_steps",
+    "serve_kv_dtype", "serve_max_queue", "serve_replicas", "serve_role",
     "serve_slots",
 ]

@@ -1,0 +1,197 @@
+"""FleetController: the master tick for the serve fleet.
+
+Closes the loop the way ``DistributedTrainer``'s master tick does for
+training workers, on the same PR-9 heartbeat channel:
+
+- **aggregate** — every tick reads each replica's newest beat payload
+  and publishes the fleet gauges (``fleet_serve_replicas``, per-replica
+  ``fleet_serve_occupancy`` / ``fleet_serve_queue_depth`` /
+  ``fleet_serve_free_slots`` / ``fleet_serve_ttft_p50_s`` /
+  ``fleet_serve_tokens_per_sec``).
+- **flag stragglers** — a replica whose TPOT exceeds
+  ``straggler_ratio`` x the fleet median (≥3 reporting) is flagged via
+  the SAME outlier rule the training master uses
+  (``parallel/workrouter.update_straggler_flags``), with the evidence
+  on the timeline (``serve.straggler`` event).
+- **evict + requeue** — a replica silent past ``DL4J_SERVE_EVICT_S``
+  (wedged: beats stopped, nobody told us why) or one whose in-process
+  loop died (crashed: the dead flag is honest local knowledge) is
+  evicted with the decision's evidence — silence, timeout, last
+  payload — appended to ``controller.eviction_log`` exactly like the
+  training master's eviction log, its per-replica gauges dropped (a
+  dead replica must stop reporting as current), and its unfinished
+  requests requeued onto survivors through
+  :meth:`FleetRouter.failover` (``serve.failover`` span). The
+  correctness contract rides on deterministic prefill: a killed
+  replica's requests complete token-identical to an unfailed run.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.monitor import metrics, record_counter, tracer
+from deeplearning4j_tpu.parallel.workrouter import update_straggler_flags
+from deeplearning4j_tpu.serving.fleet.router import FleetRouter
+from deeplearning4j_tpu.serving.scheduler import serve_evict_s
+
+__all__ = ["FleetController"]
+
+#: per-replica gauges the controller owns (published on tick, removed
+#: on eviction so a dead replica stops reporting as current)
+_REPLICA_GAUGES = {
+    "fleet_serve_occupancy": "occupancy",
+    "fleet_serve_queue_depth": "queue_depth",
+    "fleet_serve_free_slots": "free_slots",
+    "fleet_serve_ttft_p50_s": "ttft_p50",
+    "fleet_serve_tokens_per_sec": "tokens_per_sec",
+}
+
+
+class FleetController:
+    """Aggregate, flag, evict, requeue — one tick at a time."""
+
+    def __init__(self, router: FleetRouter, tracker=None, *,
+                 evict_timeout_s: Optional[float] = None,
+                 straggler_ratio: float = 3.0,
+                 clock=time.time):
+        self.router = router
+        self.tracker = tracker
+        self.evict_timeout_s = (evict_timeout_s
+                                if evict_timeout_s is not None
+                                else serve_evict_s())
+        self.straggler_ratio = float(straggler_ratio)
+        self.clock = clock
+        self.stragglers: set = set()
+        self.evicted: List[str] = []
+        self.eviction_log: List[dict] = []
+        self._evicted_set: set = set()
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._reg = metrics()
+
+    # ------------------------------------------------------------------
+    def _payload(self, replica) -> Optional[dict]:
+        """Newest beat payload — from the tracker when one is wired
+        (the cross-process path), else straight from the in-process
+        replica (same dict, no beat in between)."""
+        if self.tracker is not None:
+            return self.tracker.heartbeat_metrics(replica.replica_id)
+        return replica.heartbeat_payload() if replica.alive else None
+
+    def tick(self) -> Dict[str, dict]:
+        """One aggregation + health pass; returns the per-replica
+        payload map (tests and dashboards read it)."""
+        fleet: Dict[str, dict] = {}
+        now = self.clock()
+        for r in self.router.replicas:
+            if r.replica_id in self._evicted_set:
+                continue
+            m = self._payload(r)
+            if m:
+                fleet[r.replica_id] = m
+                for gauge, key in _REPLICA_GAUGES.items():
+                    if isinstance(m.get(key), (int, float)):
+                        self._reg.gauge(gauge).set(float(m[key]),
+                                                   replica=r.replica_id)
+        tpots = {rid: float(m["tpot_s"]) for rid, m in fleet.items()
+                 if isinstance(m.get("tpot_s"), (int, float))}
+        update_straggler_flags(
+            tpots, self.stragglers, self.straggler_ratio,
+            id_label="replica", value_key="tpot_s",
+            counter_name="fleet_serve_stragglers_total",
+            event_name="serve.straggler")
+        self._evict_pass(now, fleet)
+        alive = [r for r in self.router.replicas
+                 if r.replica_id not in self._evicted_set and r.alive]
+        self._reg.gauge("fleet_serve_replicas",
+                        "decode-serving replicas currently alive"
+                        ).set(float(len(alive)))
+        self._reg.gauge("fleet_serve_stragglers").set(
+            float(len(self.stragglers)))
+        self.router.retry_pending()
+        return fleet
+
+    # ------------------------------------------------------------------
+    def _evict_pass(self, now: float, fleet: Dict[str, dict]) -> None:
+        for r in list(self.router.replicas):
+            rid = r.replica_id
+            if rid in self._evicted_set:
+                continue
+            if r.dead:
+                # in-process crash: the loop died and told us why —
+                # no need to wait out the silence timeout
+                self.evict(rid, reason=f"crashed: {r.dead_reason}",
+                           silent_s=None, last_metrics=fleet.get(rid))
+                continue
+            if self.tracker is None:
+                continue
+            t = self.tracker.last_heartbeat(rid)
+            if t is None:
+                continue  # never beat yet (still booting) — grace
+            silent = now - t
+            if silent >= self.evict_timeout_s:
+                self.evict(rid, reason="heartbeat_silence",
+                           silent_s=round(silent, 3),
+                           last_metrics=self.tracker.heartbeat_metrics(
+                               rid) or fleet.get(rid))
+
+    def evict(self, replica_id: str, *, reason: str,
+              silent_s: Optional[float] = None,
+              last_metrics: Optional[dict] = None) -> dict:
+        """Evict one replica: evidence-logged decision, gauges dropped,
+        in-flight requests failed over. Also the bench/dryrun's forced-
+        eviction hook."""
+        replica = self.router._by_id[replica_id]
+        # kill, don't just flag: a silence-evicted replica may still be
+        # RUNNING (stalled beats, live loop) — leaving its loop up would
+        # have a zombie decoding the same requests the survivors now own
+        replica.kill(reason)
+        self._evicted_set.add(replica_id)
+        self.evicted.append(replica_id)
+        self.stragglers.discard(replica_id)
+        for gauge in _REPLICA_GAUGES:
+            self._reg.gauge(gauge).remove(replica=replica_id)
+        decision = {"replica": replica_id, "reason": reason,
+                    "silent_s": silent_s,
+                    "timeout_s": self.evict_timeout_s,
+                    "t_wall": self.clock(),
+                    "last_metrics": last_metrics}
+        record_counter("fleet_serve_evictions_total", replica=replica_id)
+        # the tracer event forwards into the flight ring on its own
+        # (span forwarding) — no explicit flight write
+        tracer().event("serve.evict", **decision)
+        summary = self.router.failover(replica_id, reason=reason)
+        decision["failover"] = summary
+        self.eviction_log.append(decision)
+        return decision
+
+    # ------------------------------------------------------------------
+    # real-time loop (the in-process fleet's master thread)
+    # ------------------------------------------------------------------
+    def start(self, interval_s: Optional[float] = None
+              ) -> "FleetController":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        interval = (interval_s if interval_s is not None
+                    else max(0.05, self.evict_timeout_s / 4))
+        stop = threading.Event()
+        self._stop = stop
+
+        def run():
+            while not stop.wait(interval):
+                self.tick()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-fleet-controller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,473 @@
+"""FleetRouter: the admission frontend in front of N replicas.
+
+The serving counterpart of ``parallel/workrouter.py``'s dispatch
+policies: where the training router decides when worker updates become
+global parameters, the serve router decides which replica a request
+lands on. Policy (stated so it can be changed deliberately):
+
+- **least-loaded placement** — free-slots-first (a replica with an open
+  slot starts decoding at its next step boundary; one with a deep queue
+  makes the request wait), with a TTFT-aware tiebreak: at equal free
+  slots the replica whose recent TTFT p50 is lower wins (it is
+  admitting faster, whatever the reason), then replica id for
+  determinism.
+- **bounded queues + spill** — each replica's own admission queue bound
+  (``DL4J_SERVE_MAX_QUEUE``) is the per-replica backpressure edge; a
+  full replica spills to the next-least-loaded one, and only when EVERY
+  alive replica is full does the router report a drop (open-loop load
+  sheds it; the loadgen's drop series records when).
+- **sticky affinity** — an in-flight stream never migrates (its slot
+  holds its KV); optionally, a caller-provided ``affinity`` key pins
+  future requests to the replica that served the key before (session
+  cache reuse), falling back to least-loaded when that replica died.
+- **failover** — when the controller evicts a replica, its unfinished
+  requests requeue onto survivors with the prompt re-prefilled. Greedy
+  streams keep the tokens already emitted and re-prefill
+  ``prompt + emitted`` (deterministic prefill ⇒ the continuation is the
+  exact suffix the dead replica would have produced); sampled streams
+  replay from scratch with the original seed (the per-request RNG chain
+  is a pure function of the seed, so the replayed stream is identical
+  too — it just cannot resume mid-chain). Either way a killed replica
+  costs recompute, never tokens: completed output is token-identical
+  to an unfailed run.
+
+In a role-split fleet (any ``prefill`` replicas present) new requests
+route to the least-loaded prefill replica, whose finished slab the
+router then places on the least-loaded decode replica
+(``place_handoff``), and failover re-enters the same pipeline.
+
+Spans: every placement runs under ``serve.route`` and every eviction
+recovery under ``serve.failover`` — both feed the flight recorder via
+the standard span forwarding, so a postmortem can replay routing
+decisions around a death.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor import metrics, tracer
+from deeplearning4j_tpu.serving.fleet.handoff import SlotHandoff, make_install
+from deeplearning4j_tpu.serving.fleet.replica import ServeReplica
+from deeplearning4j_tpu.serving.scheduler import (
+    ServeRequest, serve_replicas)
+
+__all__ = ["FleetRequest", "FleetRouter", "FleetSaturated"]
+
+_FLEET_IDS = itertools.count(1)
+
+
+class FleetSaturated(RuntimeError):
+    """Every alive replica's queue is at its bound."""
+
+
+@dataclass
+class FleetRequest:
+    """One request at fleet level: survives replica failover by
+    stitching the tokens emitted before the death (``emitted``) to the
+    current replica-local segment (``inner``)."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    seed: int = 0
+    affinity: Optional[str] = None
+    id: int = field(default_factory=lambda: next(_FLEET_IDS))
+    replica_id: Optional[str] = None
+    inner: Optional[ServeRequest] = None
+    emitted: List[int] = field(default_factory=list)
+    attempts: int = 0
+    submit_s: Optional[float] = None
+    _first_token_s: Optional[float] = None
+    # a finished prefill slab waiting for decode headroom (split mode)
+    _parked_handoff: Optional[SlotHandoff] = None
+
+    # stamped by the router when a requeue discovers everything was
+    # already streamed before the death (no inner segment remains to
+    # carry a finish timestamp)
+    _finish_s: Optional[float] = None
+
+    @property
+    def tokens(self) -> List[int]:
+        inner = self.inner.tokens if self.inner is not None else []
+        return self.emitted + list(inner)
+
+    @property
+    def finished(self) -> bool:
+        if (self.inner is None
+                and len(self.emitted) >= self.max_new_tokens):
+            # a failover found every token already emitted: complete
+            # with no live segment
+            return True
+        return (self.inner is not None
+                and self.inner.state == "finished"
+                and len(self.tokens) >= self.max_new_tokens)
+
+    @property
+    def state(self) -> str:
+        if self.finished:
+            return "finished"
+        return "queued" if self.inner is None else self.inner.state
+
+    @property
+    def first_token_s(self) -> Optional[float]:
+        if self._first_token_s is not None:
+            return self._first_token_s
+        return None if self.inner is None else self.inner.first_token_s
+
+    @property
+    def finish_s(self) -> Optional[float]:
+        if self._finish_s is not None:
+            return self._finish_s
+        return None if self.inner is None else self.inner.finish_s
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        ft = self.first_token_s
+        if self.submit_s is None or ft is None:
+            return None
+        return ft - self.submit_s
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.submit_s is None or self.finish_s is None \
+                or not self.finished:
+            return None
+        return self.finish_s - self.submit_s
+
+    @property
+    def output(self) -> np.ndarray:
+        """``prompt + generated`` — the ``generate()`` shape, for the
+        token-identity contract across failover."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, self.prompt.dtype)])
+
+
+class FleetRouter:
+    """Route requests across replicas; requeue them across deaths."""
+
+    @classmethod
+    def build(cls, model, *, replicas: Optional[int] = None,
+              tracker=None, role: Optional[str] = None,
+              clock=time.monotonic, **server_kw) -> "FleetRouter":
+        """Stand up a uniform in-process fleet: ``DL4J_SERVE_REPLICAS``
+        (or ``replicas=``) workers named ``replica-<i>``, each reading
+        its role from ``DL4J_SERVE_ROLE`` (or ``role=``) and its server
+        config from the usual ``DL4J_SERVE_*`` knobs / ``server_kw``.
+        The operator entry point the env rows document; callers needing
+        heterogeneous roles construct :class:`ServeReplica` lists
+        themselves."""
+        n = replicas if replicas is not None else serve_replicas()
+        reps = [ServeReplica(f"replica-{i}", model, tracker=tracker,
+                             role=role, clock=clock, **server_kw)
+                for i in range(n)]
+        return cls(reps, clock=clock)
+
+    def __init__(self, replicas: Sequence[ServeReplica], *,
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas = list(replicas)
+        self._by_id: Dict[str, ServeReplica] = {
+            r.replica_id: r for r in replicas}
+        self.prefill_replicas = [r for r in replicas
+                                 if r.role == "prefill"]
+        self.decode_replicas = [r for r in replicas
+                                if r.role in ("decode", "mixed")]
+        if not self.decode_replicas:
+            raise ValueError("a fleet needs at least one decode-capable "
+                             "(mixed/decode) replica")
+        self.split = bool(self.prefill_replicas)
+        temps = {r.server.engine.temperature for r in replicas}
+        if len(temps) > 1:
+            raise ValueError(
+                f"replicas disagree on sampling temperature ({temps}): "
+                "failover token-identity needs one fleet-wide config")
+        self.greedy = temps.pop() == 0.0
+        # pool config must be fleet-uniform too: a failover continuation
+        # or a handoff landing on a smaller/differently-quantized pool
+        # would raise mid-recovery (or mid-step, killing a healthy
+        # replica) — reject the misconfiguration at construction
+        for attr in ("max_len", "kv_dtype"):
+            vals = {getattr(r.server.engine, attr) for r in replicas}
+            if len(vals) > 1:
+                raise ValueError(
+                    f"replicas disagree on {attr} ({vals}): failover "
+                    "and handoff need one fleet-wide pool config")
+        if self.prefill_replicas:
+            spec = [r.replica_id for r in self.decode_replicas
+                    if r.server.engine.spec]
+            if spec:
+                raise ValueError(
+                    f"decode replicas {spec} run speculative decoding, "
+                    "which cannot accept handoffs (no draft-pool prompt "
+                    "K/V) — a split fleet needs non-speculative decode "
+                    "replicas")
+        self.clock = clock
+        self.requests: List[FleetRequest] = []
+        self._affinity: Dict[str, str] = {}
+        # failover parking lot: requeues that found every survivor full
+        # wait here and retry on the next controller tick / submission
+        self._pending: List[FleetRequest] = []
+        self._lock = threading.RLock()
+        self._reg = metrics()
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+    def _alive_decode(self) -> List[ServeReplica]:
+        return [r for r in self.decode_replicas if r.alive]
+
+    def _alive_prefill(self) -> List[ServeReplica]:
+        return [r for r in self.prefill_replicas if r.alive]
+
+    @staticmethod
+    def _rank(replicas: List[ServeReplica]) -> List[ServeReplica]:
+        """Least-loaded first: headroom = free slots MINUS queued
+        requests (queued work claims a slot at the next boundary — free
+        slots alone would send a whole arrival burst to one replica,
+        since admission only moves the count at step boundaries), then
+        recent TTFT p50 ascending (no samples = no traffic yet = 0, so
+        fresh replicas absorb load), then id for determinism."""
+        return sorted(replicas,
+                      key=lambda r: (-(r.server.free_slot_count()
+                                       - r.queue_depth()),
+                                     r.ttft_p50() or 0.0,
+                                     r.replica_id))
+
+    def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               affinity: Optional[str] = None) -> FleetRequest:
+        """Admit one request into the fleet; raises
+        :class:`FleetSaturated` when every alive replica is full."""
+        freq = self.try_submit(prompt, max_new_tokens, seed=seed,
+                               affinity=affinity)
+        if freq is None:
+            raise FleetSaturated(
+                "every alive replica's queue is at its bound")
+        return freq
+
+    def try_submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
+                   affinity: Optional[str] = None
+                   ) -> Optional[FleetRequest]:
+        """Non-raising admission: ``None`` means the fleet shed the
+        request (every alive replica full) — open-loop callers record
+        the drop and move on."""
+        with self._lock:
+            self.retry_pending()
+            freq = FleetRequest(
+                prompt=np.asarray(prompt, np.int32).reshape(-1),
+                max_new_tokens=int(max_new_tokens), seed=int(seed),
+                affinity=affinity)
+            freq.submit_s = self.clock()
+            if self._place(freq, freq.prompt, freq.max_new_tokens):
+                self.requests.append(freq)
+                return freq
+            self._reg.counter("serve_route_total").inc(outcome="dropped")
+            return None
+
+    def _place(self, freq: FleetRequest, prompt,
+               max_new_tokens: int) -> bool:
+        """One routing decision under a ``serve.route`` span: prefill
+        pipeline in split mode, else direct decode placement with
+        affinity-first + least-loaded + spill."""
+        with tracer().span("serve.route", request=freq.id) as sp:
+            if self.split:
+                # the mixed path gets this check from try_submit; the
+                # prefill pipeline builds its ServeRequest directly, so
+                # validate here or an oversized request would scatter
+                # past T_max on the decode side (silently clipped) —
+                # or kill a prefill replica's worker thread
+                total = int(np.asarray(prompt).size) + max_new_tokens
+                cap = self.decode_replicas[0].server.max_len
+                if total > cap:
+                    raise ValueError(
+                        f"prompt_len + max_new_tokens = {total} exceeds "
+                        f"the fleet's slot capacity max_len={cap}")
+                # each prefill replica's job queue is bounded by the
+                # same DL4J_SERVE_MAX_QUEUE edge as decode admission —
+                # without it, split-mode overload would grow host
+                # memory (queued prompts + parked slabs) without ever
+                # shedding, while a mixed fleet correctly drops
+                pre = [r for r in sorted(
+                    self._alive_prefill(),
+                    key=lambda r: (r.queue_depth(), r.replica_id))
+                    if r.queue_depth() < r.server.queue.max_depth]
+                if not pre:
+                    sp.attrs["outcome"] = "prefill_saturated"
+                    return False
+                req = ServeRequest(
+                    prompt=np.asarray(prompt, np.int32).reshape(-1),
+                    max_new_tokens=max_new_tokens, seed=freq.seed)
+                req.submit_s = freq.submit_s
+                freq.inner = req
+                freq.replica_id = pre[0].replica_id
+                freq.attempts += 1
+                pre[0].enqueue_prefill(freq, self.place_handoff)
+                sp.attrs.update(outcome="prefill",
+                                replica=pre[0].replica_id)
+                self._reg.counter("serve_route_total").inc(
+                    outcome="prefill")
+                return True
+            cands = self._rank(self._alive_decode())
+            if freq.affinity is not None:
+                pinned = self._by_id.get(self._affinity.get(freq.affinity))
+                if pinned is not None and pinned.alive:
+                    cands = [pinned] + [r for r in cands if r is not pinned]
+            spilled = 0
+            for r in cands:
+                verdict = r.server.try_submit(prompt, max_new_tokens,
+                                              seed=freq.seed)
+                if verdict.admitted:
+                    freq.inner = verdict.request
+                    freq.replica_id = r.replica_id
+                    freq.attempts += 1
+                    if freq.affinity is not None:
+                        self._affinity[freq.affinity] = r.replica_id
+                    sp.attrs.update(outcome="placed",
+                                    replica=r.replica_id,
+                                    spilled=spilled,
+                                    queue_depth=verdict.queue_depth)
+                    self._reg.counter("serve_route_total").inc(
+                        outcome="placed")
+                    if spilled:
+                        self._reg.counter(
+                            "fleet_serve_spills_total").inc(spilled)
+                    return True
+                spilled += 1
+            sp.attrs.update(outcome="saturated", spilled=spilled)
+            return False
+
+    def place_handoff(self, freq: FleetRequest,
+                      handoff: SlotHandoff) -> bool:
+        """Place a prefilled slab on the least-loaded decode replica
+        (headroom = free slots minus already-queued handoffs); parks the
+        request for retry when every decode replica is packed."""
+        with self._lock, tracer().span("serve.handoff",
+                                       request=freq.id) as sp:
+            cands = sorted(
+                self._alive_decode(),
+                key=lambda r: (-r.server.handoff_headroom(),
+                               r.replica_id))
+            for r in cands:
+                if r.server.handoff_headroom() <= 0:
+                    continue
+                r.server.admit_external(freq.inner, make_install(handoff))
+                freq.replica_id = r.replica_id
+                sp.attrs.update(outcome="placed", replica=r.replica_id)
+                return True
+            # no headroom anywhere: hold the finished prefill and retry
+            # at the next tick (the slab is host-resident — it costs
+            # memory, not a slot)
+            freq._parked_handoff = handoff
+            if freq not in self._pending:
+                self._pending.append(freq)
+            sp.attrs["outcome"] = "parked"
+            return False
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+    def failover(self, replica_id: str, *,
+                 reason: str = "evicted") -> dict:
+        """Requeue the dead replica's unfinished requests onto
+        survivors. Returns a summary for the eviction evidence log."""
+        with self._lock, tracer().span("serve.failover",
+                                       replica=replica_id,
+                                       reason=reason) as sp:
+            victims = [fr for fr in self.requests
+                       if fr.replica_id == replica_id and not fr.finished]
+            # a victim may ALSO sit in the parking lot (its handoff
+            # found no headroom before the death): drop it there first,
+            # or the next retry would place the same request twice
+            drop = set(map(id, victims))
+            self._pending = [fr for fr in self._pending
+                             if id(fr) not in drop]
+            requeued = parked = 0
+            for fr in victims:
+                if self._requeue(fr):
+                    requeued += 1
+                else:
+                    parked += 1
+            sp.attrs.update(requeued=requeued, parked=parked)
+            if victims:
+                self._reg.counter(
+                    "fleet_serve_failover_requests_total").inc(
+                    len(victims))
+            return {"victims": len(victims), "requeued": requeued,
+                    "parked": parked}
+
+    def _requeue(self, fr: FleetRequest) -> bool:
+        inner = fr.inner
+        if self.greedy and inner is not None and inner.tokens:
+            # keep what was already streamed; re-prefill prompt+prefix —
+            # deterministic prefill makes the continuation the exact
+            # suffix of the unfailed stream
+            fr._first_token_s = fr.first_token_s
+            fr.emitted.extend(inner.tokens)
+        else:
+            # sampled (or nothing emitted): replay from scratch with the
+            # original seed — the per-request RNG chain is a pure
+            # function of the seed, so the replayed stream is identical
+            fr.emitted = []
+            fr._first_token_s = None
+        fr.inner = None
+        fr.replica_id = None
+        fr._parked_handoff = None
+        if len(fr.emitted) >= fr.max_new_tokens:
+            # everything already streamed before the death (e.g. a
+            # prefill-complete max_new=1 request whose handoff never
+            # installed): complete it here — no survivor has work to do
+            fr._finish_s = self.clock()
+            return True
+        return self._place_continuation(fr)
+
+    def _place_continuation(self, fr: FleetRequest) -> bool:
+        prompt = (np.concatenate(
+            [fr.prompt, np.asarray(fr.emitted, np.int32)])
+            if fr.emitted else fr.prompt)
+        remaining = fr.max_new_tokens - len(fr.emitted)
+        if self._place(fr, prompt, remaining):
+            return True
+        self._pending.append(fr)
+        return False
+
+    def retry_pending(self) -> int:
+        """Drain the failover parking lot (called on every tick and
+        submission); returns how many found a home. Failures re-park
+        themselves (``place_handoff`` / ``_place_continuation`` both
+        append back on a miss)."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+            placed = 0
+            for fr in pending:
+                handoff, fr._parked_handoff = fr._parked_handoff, None
+                if handoff is not None:
+                    ok = self.place_handoff(fr, handoff)
+                else:
+                    ok = self._place_continuation(fr)
+                placed += int(ok)
+            return placed
+
+    # ------------------------------------------------------------------
+    def unfinished(self) -> List[FleetRequest]:
+        with self._lock:
+            return [fr for fr in self.requests if not fr.finished]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": len(self.replicas),
+                "alive": sum(1 for r in self.replicas if r.alive),
+                "split": self.split,
+                "requests": len(self.requests),
+                "finished": sum(1 for fr in self.requests if fr.finished),
+                "pending_failover": len(self._pending),
+            }

@@ -67,6 +67,12 @@ class LoadReport:
     ttfts_s: List[float] = field(default_factory=list)
     tpots_s: List[float] = field(default_factory=list)
     occupancy: List[float] = field(default_factory=list)
+    # per-drop timestamps (seconds since stream start): overflow drops
+    # used to survive only as a count, which made a fleet that sheds
+    # load at t=0.1s indistinguishable from one that sheds at t=9.9s —
+    # the series lets fleet-vs-single comparisons see WHEN capacity ran
+    # out, not just how often
+    drop_times_s: List[float] = field(default_factory=list)
     submitted: int = 0
     rejected: int = 0
     finished: int = 0
@@ -97,6 +103,9 @@ class LoadReport:
                                if self.tpots_s else None, ms),
             "occupancy_mean": (round(float(np.mean(self.occupancy)), 3)
                                if self.occupancy else None),
+            # shed load, accounted in time: the sorted drop timestamps
+            "dropped_request_seconds": [round(t, 3)
+                                        for t in sorted(self.drop_times_s)],
         }
 
 
@@ -123,13 +132,28 @@ def run_open_loop(server, schedule: List[Arrival], *,
         while i < len(schedule) and schedule[i].arrival_s <= now:
             a = schedule[i]
             i += 1
-            report.submitted += 1
-            try:
-                reqs.append(server.submit(a.prompt, a.max_new_tokens,
-                                          seed=a.seed))
-            except ServeQueueFull:
+            try_submit = getattr(server, "try_submit", None)
+            if try_submit is not None:
+                verdict = try_submit(a.prompt, a.max_new_tokens,
+                                     seed=a.seed)
+                admitted = verdict.admitted
+                req = verdict.request
+            else:
+                # a server without the non-blocking surface: legacy path
+                try:
+                    req = server.submit(a.prompt, a.max_new_tokens,
+                                        seed=a.seed)
+                    admitted = True
+                except ServeQueueFull:
+                    admitted, req = False, None
+            if admitted:
+                report.submitted += 1
+                reqs.append(req)
+            else:
+                # open loop drops, it does not retry — but it records
+                # WHEN it dropped, so shed load is visible in time
                 report.rejected += 1
-                report.submitted -= 1
+                report.drop_times_s.append(now)
         progressed = server.step()
         report.occupancy.append(server.occupancy())
         if not progressed and i < len(schedule):

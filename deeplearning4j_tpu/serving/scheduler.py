@@ -24,8 +24,10 @@ from typing import Deque, List, Optional
 import numpy as np
 
 __all__ = ["ServeRequest", "ServeQueueFull", "RequestQueue",
-           "serve_slots", "serve_max_queue", "serve_fuse_steps",
-           "serve_kv_dtype", "serve_draft_layers"]
+           "AdmissionVerdict", "serve_slots", "serve_max_queue",
+           "serve_fuse_steps", "serve_kv_dtype", "serve_draft_layers",
+           "serve_replicas", "serve_role", "serve_evict_s",
+           "SERVE_ROLES"]
 
 _IDS = itertools.count(1)
 
@@ -81,8 +83,70 @@ def serve_max_queue(default: int = 64) -> int:
         return default
 
 
+# ---------------------------------------------------------------------------
+# fleet knobs (serving/fleet/)
+# ---------------------------------------------------------------------------
+
+#: replica roles for the prefill/decode split: ``mixed`` replicas run the
+#: whole request lifecycle (the single-replica behavior), ``prefill``
+#: replicas only compute prompt K/V slabs and hand them off, ``decode``
+#: replicas only accept handed-off slabs and stream tokens.
+SERVE_ROLES = ("mixed", "prefill", "decode")
+
+
+def serve_replicas(default: int = 2) -> int:
+    """``DL4J_SERVE_REPLICAS``: how many ``DecodeServer`` replicas a
+    fleet builder stands up (``serving/fleet``)."""
+    raw = os.environ.get("DL4J_SERVE_REPLICAS", "")
+    try:
+        return max(1, int(raw)) if raw else default
+    except ValueError:
+        return default
+
+
+def serve_role(default: str = "mixed") -> str:
+    """``DL4J_SERVE_ROLE``: this process's replica role in a
+    prefill/decode-disaggregated fleet (``mixed``/``prefill``/
+    ``decode``). An unknown value raises — a replica silently falling
+    back to ``mixed`` would serve decode traffic a router believes it
+    routed elsewhere."""
+    raw = os.environ.get("DL4J_SERVE_ROLE", "").strip().lower()
+    if not raw:
+        return default
+    if raw not in SERVE_ROLES:
+        raise ValueError(
+            f"DL4J_SERVE_ROLE={raw!r} must be one of {SERVE_ROLES}")
+    return raw
+
+
+def serve_evict_s(default: float = 10.0) -> float:
+    """``DL4J_SERVE_EVICT_S``: heartbeat-silence timeout after which the
+    fleet controller evicts a replica and requeues its in-flight
+    requests onto survivors."""
+    raw = os.environ.get("DL4J_SERVE_EVICT_S", "")
+    try:
+        return max(0.1, float(raw)) if raw else default
+    except ValueError:
+        return default
+
+
 class ServeQueueFull(RuntimeError):
     """Backpressure signal: the admission queue is at its bound."""
+
+
+@dataclass(frozen=True)
+class AdmissionVerdict:
+    """Outcome of a non-blocking ``DecodeServer.try_submit``: either the
+    request was enqueued (``admitted``, ``request`` set) or the server
+    reported why not (``reason``) — so a routing frontend can place
+    against many replicas without exception-driven control flow.
+    ``queue_depth`` is the admission queue's depth at decision time
+    (the spill signal)."""
+
+    admitted: bool
+    reason: Optional[str] = None          # None | "queue_full"
+    request: Optional["ServeRequest"] = None
+    queue_depth: int = 0
 
 
 @dataclass
@@ -99,6 +163,10 @@ class ServeRequest:
     seed: int = 0
     id: int = field(default_factory=lambda: next(_IDS))
     state: str = "queued"          # queued | running | finished
+    # True once the request entered a server through a slab handoff:
+    # its TTFT belongs to the PREFILL side (stamped there), so the
+    # decode side must not re-attribute it to itself
+    handoff: bool = False
     slot: Optional[int] = None
     submit_s: Optional[float] = None
     first_token_s: Optional[float] = None
@@ -135,11 +203,17 @@ class RequestQueue:
         self._q: Deque[ServeRequest] = deque()
 
     def push(self, req: ServeRequest) -> None:
+        if not self.try_push(req):
+            raise ServeQueueFull(
+                f"serve queue at max depth {self.max_depth}")
+
+    def try_push(self, req: ServeRequest) -> bool:
+        """Non-raising ``push``: False when the queue is at its bound."""
         with self._lock:
             if len(self._q) >= self.max_depth:
-                raise ServeQueueFull(
-                    f"serve queue at max depth {self.max_depth}")
+                return False
             self._q.append(req)
+            return True
 
     def pop(self) -> Optional[ServeRequest]:
         with self._lock:

@@ -39,15 +39,17 @@ live, like every span).
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Sequence
+from collections import deque
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.monitor import metrics, tracer
 from deeplearning4j_tpu.serving.engine import DecodeEngine
 from deeplearning4j_tpu.serving.scheduler import (
-    RequestQueue, ServeRequest, serve_draft_layers, serve_fuse_steps,
-    serve_kv_dtype, serve_max_queue, serve_slots)
+    AdmissionVerdict, RequestQueue, ServeQueueFull, ServeRequest,
+    serve_draft_layers, serve_fuse_steps, serve_kv_dtype,
+    serve_max_queue, serve_slots)
 
 __all__ = ["DecodeServer"]
 
@@ -96,6 +98,11 @@ class DecodeServer:
         self._last_tok_s = np.zeros(self.slots, np.float64)
         self._keys = self._zero_keys()
         self._draft_keys = self._zero_keys() if self.engine.spec else None
+        # externally-prefilled requests waiting for a free slot: each
+        # entry carries an ``install(engine, slot) -> (last_tok, key)``
+        # that lands the handed-off KV slab + cursor into the slot
+        # (serving/fleet/handoff.py builds these)
+        self._handoffs: Deque[Tuple[ServeRequest, Callable]] = deque()
         self.finished: List[ServeRequest] = []
         self.steps = 0
         self.decode_tokens = 0
@@ -119,6 +126,20 @@ class DecodeServer:
         """Enqueue one request. Validates against the slot capacity the
         way ``generate`` validates against its cache size; raises
         :class:`~.scheduler.ServeQueueFull` at the queue bound."""
+        verdict = self.try_submit(prompt, max_new_tokens, seed=seed)
+        if not verdict.admitted:
+            raise ServeQueueFull(
+                f"serve queue at max depth {self.queue.max_depth}")
+        return verdict.request
+
+    def try_submit(self, prompt, max_new_tokens: int, *,
+                   seed: int = 0) -> AdmissionVerdict:
+        """Non-blocking ``submit``: returns an
+        :class:`~.scheduler.AdmissionVerdict` instead of raising at the
+        queue bound, so a routing frontend can place across replicas
+        without exception-driven control flow. Malformed requests
+        (empty prompt, capacity overflow) still raise — those are
+        caller bugs, not load conditions."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("prompt must hold at least one token")
@@ -137,14 +158,39 @@ class DecodeServer:
         req = ServeRequest(prompt=prompt, max_new_tokens=max_new_tokens,
                            seed=seed)
         req.submit_s = self.clock()
-        try:
-            self.queue.push(req)
-        except Exception:
+        if not self.queue.try_push(req):
             self._reg.counter("serve_requests_total").inc(event="rejected")
-            raise
+            return AdmissionVerdict(admitted=False, reason="queue_full",
+                                    queue_depth=len(self.queue))
         self._reg.counter("serve_requests_total").inc(event="submitted")
         self._reg.gauge("serve_queue_depth").set(len(self.queue))
-        return req
+        return AdmissionVerdict(admitted=True, request=req,
+                                queue_depth=len(self.queue))
+
+    def admit_external(self, req: ServeRequest,
+                       install: Callable) -> None:
+        """Queue an externally-prefilled request (prefill/decode split):
+        at the next step boundary a free slot is claimed and
+        ``install(engine, slot) -> (last_token, rng_key)`` lands the
+        handed-off KV slab + cursor into it — the request then decodes
+        exactly like a locally-prefilled one. ``req`` must already carry
+        its first token (the prefill replica sampled it); its TTFT was
+        recorded at prefill time, so this path never re-observes it."""
+        if self.engine.spec:
+            raise ValueError(
+                "handoff into a speculative decode server is "
+                "unsupported: the draft pool holds no prompt K/V for "
+                "the handed-off slot")
+        if not req.tokens:
+            raise ValueError(
+                "admit_external needs a prefilled request (its first "
+                "token sampled by the prefill replica)")
+        self._handoffs.append((req, install))
+
+    def handoff_headroom(self) -> int:
+        """Free slots not yet spoken for by queued handoffs — the
+        router's can-this-replica-take-a-slab signal."""
+        return self.free_slot_count() - len(self._handoffs)
 
     # ------------------------------------------------------------------
     # the serve loop
@@ -155,17 +201,49 @@ class DecodeServer:
     def _live_slots(self) -> List[int]:
         return [s for s, r in enumerate(self._slot_req) if r is not None]
 
+    def free_slot_count(self) -> int:
+        """How many slots the next step boundary can admit into — the
+        router's least-loaded placement signal."""
+        return len(self._free_slots())
+
     def occupancy(self) -> float:
         return len(self._live_slots()) / self.slots
 
     def busy(self) -> bool:
-        return bool(self._live_slots()) or len(self.queue) > 0
+        return (bool(self._live_slots()) or len(self.queue) > 0
+                or bool(self._handoffs))
+
+    def _admit_handoff(self, slot: int) -> None:
+        req, install = self._handoffs.popleft()
+        with tracer().span("serve.handoff.install", request=req.id,
+                           slot=slot):
+            last_tok, key = install(self.engine, slot)
+        now = self.clock()
+        req.state = "running"
+        req.handoff = True
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._last_tok[slot] = int(last_tok)
+        self._last_tok_s[slot] = now
+        self._keys = self._keys.at[slot].set(key)
+        # TTFT was recorded by the prefill replica; the installed slab
+        # already covers every emitted token, so a request that arrived
+        # complete just retires
+        if len(req.tokens) >= req.max_new_tokens:
+            self._retire(slot, now)
 
     def _admit(self) -> int:
         import jax
 
         admitted = 0
         for slot in self._free_slots():
+            # handed-off slabs first: their prefill compute is already
+            # spent — a queued prompt admitted ahead of them would idle
+            # a finished prefill while burning a slot on new work
+            if self._handoffs:
+                self._admit_handoff(slot)
+                admitted += 1
+                continue
             req = self.queue.pop()
             if req is None:
                 break

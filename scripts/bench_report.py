@@ -90,6 +90,20 @@ TRACKED = [
      "higher"),
     ("serve_max_slots_int8",
      lambda r: _dig(r, "serve", "max_slots_int8"), "higher"),
+    # the serve fleet (PR 13): aggregate throughput and 1->2-replica
+    # scaling gate higher; fleet latency percentiles and the
+    # failover-recovery time gate lower
+    ("serve_fleet_tokens_per_sec",
+     lambda r: _dig(r, "serve_fleet", "fleet_tokens_per_sec"), "higher"),
+    ("serve_fleet_scaling_2r",
+     lambda r: _dig(r, "serve_fleet", "tokens_per_sec_scaling_2r"),
+     "higher"),
+    ("serve_fleet_p99_latency_ms",
+     lambda r: _dig(r, "serve_fleet", "p99_latency_ms_2r"), "lower"),
+    ("serve_fleet_ttft_p50_ms",
+     lambda r: _dig(r, "serve_fleet", "ttft_p50_ms_2r"), "lower"),
+    ("serve_fleet_failover_s",
+     lambda r: _dig(r, "serve_fleet", "failover_complete_s"), "lower"),
 ]
 
 # direction lookup for scored series; headline:* keys inherit "higher"

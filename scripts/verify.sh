@@ -34,6 +34,15 @@
 #                                      compile flatness, prompt ladder,
 #                                      loadgen — plus the host-sync lint
 #                                      over the serve hot path)
+#        scripts/verify.sh --fleet    (just the serve-fleet suite —
+#                                      routing policy, failover token
+#                                      identity, controller eviction +
+#                                      straggler flagging, prefill/
+#                                      decode handoff, virtual-clock
+#                                      driver, replica-kill chaos — plus
+#                                      the host-sync lint over
+#                                      serving/fleet/'s traced slot
+#                                      movers)
 #        scripts/verify.sh --lint     (static analysis gate: the full
 #                                      dl4j-lint ruleset over the tree +
 #                                      the program-contract checks and
@@ -46,9 +55,10 @@
 #                                      over the committed BENCH_r*.json
 #                                      trajectory; nonzero exit on a
 #                                      bench regression)
-# The eval/epoch/dp/heal/obs/serve/lint/profile tests are part of the
-# default tier-1 run; --eval/--epoch/--dp/--heal/--obs/--serve/--lint/
-# --profile are the narrow fast paths for iterating on those surfaces.
+# The eval/epoch/dp/heal/obs/serve/fleet/lint/profile tests are part of
+# the default tier-1 run; --eval/--epoch/--dp/--heal/--obs/--serve/
+# --fleet/--lint/--profile are the narrow fast paths for iterating on
+# those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -84,6 +94,15 @@ elif [ "${1:-}" = "--serve" ]; then
     # bodies (serving/engine.py hot roots) must stay free of host
     # readbacks — the one sanctioned [S] token readback lives in
     # server.py, outside the traced surface
+    python scripts/dl4j_lint.py --select host-sync-in-hot-path \
+        deeplearning4j_tpu/serving || exit 1
+elif [ "${1:-}" = "--fleet" ]; then
+    shift
+    TARGET=tests/test_serving_fleet.py
+    # the fleet's traced slot movers (handoff export/import) are hot
+    # roots like the engine's program bodies: the per-request handoff
+    # readback lives OUTSIDE them (export_slot), and the lint keeps any
+    # new sync from riding into the compiled pool programs
     python scripts/dl4j_lint.py --select host-sync-in-hot-path \
         deeplearning4j_tpu/serving || exit 1
 elif [ "${1:-}" = "--lint" ]; then

@@ -1203,12 +1203,15 @@ def bench_eval():
 
 
 def _transformer(t, vocab=8192, d=512, layers=8, heads=8, attn="auto",
-                 remat=False, window=None):
+                 remat=False, window=None, policy="mixed_bf16"):
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
+    # mixed_bf16 = bf16 forward/backward on a per-step parameter copy
+    # with f32 master weights + f32 Adam state (the training default);
+    # policy="float32" builds the speedup-probe baseline
     return TransformerLM(vocab_size=vocab, d_model=d, num_heads=heads,
                          num_layers=layers, max_len=t, seed=0,
-                         dtype_policy="bf16", attn_impl=attn, remat=remat,
+                         dtype_policy=policy, attn_impl=attn, remat=remat,
                          attn_window=window)
 
 
@@ -1231,10 +1234,11 @@ def _transformer_flops_per_token(lm, t):
 
 
 def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
-                           remat=False, window=None):
+                           remat=False, window=None, policy="mixed_bf16"):
     import jax.numpy as jnp
 
-    lm = _transformer(t, attn=attn, remat=remat, window=window).init()
+    lm = _transformer(t, attn=attn, remat=remat, window=window,
+                      policy=policy).init()
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 8192, (batch, t)), jnp.int32)
     _sync(tokens)
@@ -1272,7 +1276,8 @@ def _bench_transformer_cfg(batch, t, steps=10, fused_k=10, attn="auto",
             0.0 if sec_fused == float("inf")
             else round(batch * t / sec_fused, 1)),
         "batch": batch, "seq_len": t, "remat": remat,
-        "attn_impl": lm._attn_impl(t),
+        "attn_impl": lm._attn_impl(t, train=True),
+        "dtype_policy": lm.dtype_policy_name,
         "model_tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
         "model_tflops_analytic": round(tflops_analytic, 1),
         "mfu_pct_analytic": round(
@@ -1351,7 +1356,34 @@ def bench_transformer(cpu_baseline=True, on_progress=None):
     except Exception as e:
         win_cfg = {"error": str(e)[:200]}
         _log(f"transformer t4096 w1024 FAILED: {e}")
-    progress(long_context_t4096=flash_cfg, long_context_t4096_w1024=win_cfg)
+    # mixed-precision speedup probe: the SAME b16 t1024 config under the
+    # float32 policy, PER-STEP path vs the sweep entry's PER-STEP number
+    # — strictly like-for-like (the best-of-fused tokens/sec would fold
+    # dispatch amortization into a dtype claim). The artifact evidence
+    # that the bf16 step buys MXU rate, not just smaller buffers (gated
+    # as train_step_bf16_speedup, higher is better).
+    b16_step_tps = (sweep.get("16") or {}).get(
+        "per_step_tokens_per_sec", 0.0) or 0.0
+    bf16_speedup = None
+    if b16_step_tps:
+        try:
+            lm32 = _transformer(1024, policy="float32").init()
+            step32 = lm32.make_train_step()
+            tokens32 = jnp.asarray(np.random.default_rng(0).integers(
+                0, 8192, (16, 1024)), jnp.int32)
+            sec32 = _time_loop(
+                lambda: lm32.fit_batch(tokens32, train_step=step32,
+                                       block=False),
+                steps=3, sync=lambda: lm32.params)
+            tps32 = 16 * 1024 / sec32
+            bf16_speedup = round(b16_step_tps / tps32, 2)
+            _log(f"transformer f32 per-step baseline: {tps32:,.0f} tok/s "
+                 f"→ bf16 step speedup {bf16_speedup:.2f}x")
+        except Exception as e:
+            _log(f"transformer f32 speedup probe FAILED: {e}")
+    progress(long_context_t4096=flash_cfg,
+             long_context_t4096_w1024=win_cfg,
+             train_step_bf16_speedup=bf16_speedup)
 
     # vs_baseline is strictly like-for-like: the b16 t1024 TPU number over
     # the SAME config on XLA-CPU (the sweep's best batch may differ)
@@ -1393,10 +1425,12 @@ def bench_transformer(cpu_baseline=True, on_progress=None):
     # sweep errored out and there is no per-config block to keep
     result.setdefault("flops_source",
                       "analytic 6*N/token + attention term")
-    result["config"] = "d512 L8 H8 v8192 bf16"
+    result["config"] = "d512 L8 H8 v8192 mixed_bf16 (f32 masters)"
     result["batch_sweep_t1024"] = sweep
     result["long_context_t4096"] = flash_cfg
     result["long_context_t4096_w1024"] = win_cfg
+    if bf16_speedup is not None:
+        result["train_step_bf16_speedup"] = bf16_speedup
     return result, vs_baseline
 
 

@@ -300,6 +300,52 @@ LEDGER_FLIGHT_CALLS = {
 }
 
 
+def hot_functions(module: Module) -> Set[ast.AST]:
+    """The module's HOT function/lambda scopes: ``@traced`` defs and
+    ``HOT_PATH_REGISTRY`` names, closed over the module-local call graph
+    (bare callee names) and containment edges (nested defs AND lambdas
+    run inside their parent's trace). Shared by the host-sync and
+    implicit-f32-promotion rules so "inside a traced hot path" means
+    the same thing to both."""
+    defs = list(iter_defs(module.tree))
+    by_name: Dict[str, List[ast.AST]] = {}
+    for fn in defs:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    scopes = defs + [n for n in ast.walk(module.tree)
+                     if isinstance(n, ast.Lambda)]
+    callees: Dict[ast.AST, Set[str]] = {}
+    children: Dict[ast.AST, List[ast.AST]] = {}
+    for fn in scopes:
+        names: Set[str] = set()
+        for node in own_body_walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d:
+                    names.add(d.split(".")[-1])
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                children.setdefault(fn, []).append(node)
+        callees[fn] = names
+
+    hot: Set[ast.AST] = set()
+    work = [fn for fn in defs
+            if fn.name in HOT_PATH_REGISTRY
+            or has_decorator(fn, "traced")]
+    while work:
+        fn = work.pop()
+        if fn in hot:
+            continue
+        hot.add(fn)
+        work.extend(children.get(fn, []))
+        for callee_name in callees.get(fn, ()):
+            for target in by_name.get(callee_name, ()):
+                if target not in hot:
+                    work.append(target)
+    return hot
+
+
 class HostSyncRule(Rule):
     id = "host-sync-in-hot-path"
     doc = ("host-synchronizing call (float()/.item()/np.asarray/"
@@ -311,45 +357,7 @@ class HostSyncRule(Rule):
            "function or a HOT_PATH_REGISTRY root")
 
     def check(self, module: Module, config: LintConfig) -> List[Finding]:
-        defs = list(iter_defs(module.tree))
-        by_name: Dict[str, List[ast.AST]] = {}
-        for fn in defs:
-            by_name.setdefault(fn.name, []).append(fn)
-
-        # module-local call graph: scope -> bare callee names, plus
-        # containment edges (nested defs AND lambdas run inside their
-        # parent's trace — closure syntax must not change coverage)
-        scopes = defs + [n for n in ast.walk(module.tree)
-                         if isinstance(n, ast.Lambda)]
-        callees: Dict[ast.AST, Set[str]] = {}
-        children: Dict[ast.AST, List[ast.AST]] = {}
-        for fn in scopes:
-            names: Set[str] = set()
-            for node in own_body_walk(fn):
-                if isinstance(node, ast.Call):
-                    d = dotted(node.func)
-                    if d:
-                        names.add(d.split(".")[-1])
-                elif isinstance(node, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef,
-                                       ast.Lambda)):
-                    children.setdefault(fn, []).append(node)
-            callees[fn] = names
-
-        hot: Set[ast.AST] = set()
-        work = [fn for fn in defs
-                if fn.name in HOT_PATH_REGISTRY
-                or has_decorator(fn, "traced")]
-        while work:
-            fn = work.pop()
-            if fn in hot:
-                continue
-            hot.add(fn)
-            work.extend(children.get(fn, []))
-            for callee_name in callees.get(fn, ()):
-                for target in by_name.get(callee_name, ()):
-                    if target not in hot:
-                        work.append(target)
+        hot = hot_functions(module)
 
         out: List[Finding] = []
         for fn in hot:
@@ -398,6 +406,127 @@ class HostSyncRule(Rule):
         return (isinstance(arg, ast.Call)
                 and isinstance(arg.func, ast.Name)
                 and arg.func.id == "len")
+
+
+# ---------------------------------------------------------------------------
+# implicit-f32-promotion
+# ---------------------------------------------------------------------------
+
+# contraction entry points whose operand dtype decides the MXU rate
+MATMUL_CALL_NAMES = {"einsum", "matmul", "dot", "dot_general",
+                     "tensordot"}
+# wrappers that make the operand's dtype EXPLICIT (the policy casts, a
+# direct astype, or the master-weights per-step copy)
+CAST_CALL_NAMES = {"cast_compute", "cast_param", "cast_output", "astype",
+                   "asarray", "compute_copy"}
+
+
+class ImplicitF32PromotionRule(Rule):
+    id = "implicit-f32-promotion"
+    doc = ("matmul/einsum operand inside a traced hot path reaches a "
+           "param leaf (a string-keyed subscript like params['W'] / "
+           "blk['attn']['wq'], or a name bound from one) without "
+           "passing through policy.cast_compute — under the bf16 "
+           "policy the f32 leaf silently promotes the whole "
+           "contraction to f32 MXU rate (the transformer "
+           "residual-stream bug class)")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in hot_functions(module):
+            param_names = self._param_bound_names(fn)
+            for node in own_body_walk(fn):
+                operands = self._matmul_operands(node)
+                for op in operands:
+                    leaf = self._uncast_param_ref(op, param_names)
+                    if leaf is None:
+                        continue
+                    scope = getattr(fn, "name", "<lambda>")
+                    self.emit(
+                        out, module, node,
+                        f"matmul operand '{leaf}' reaches a param leaf "
+                        "without policy.cast_compute inside hot path "
+                        f"'{scope}' — an f32 leaf here promotes the "
+                        "contraction off the bf16 MXU rate")
+        return out
+
+    @staticmethod
+    def _matmul_operands(node: ast.AST) -> List[ast.expr]:
+        if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                      ast.MatMult):
+            return [node.left, node.right]
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d and d.split(".")[-1] in MATMUL_CALL_NAMES:
+                # skip einsum specs / dimension-number tuples — only
+                # array-shaped operands carry a dtype
+                return [a for a in node.args
+                        if not isinstance(a, (ast.Constant, ast.Tuple))]
+        return []
+
+    @classmethod
+    def _param_bound_names(cls, fn: ast.AST) -> Set[str]:
+        """Names bound (flow-insensitively) from a param-leaf expression
+        within ``fn`` — one level of propagation, enough for the
+        ``w = blk['attn']['wq']; x @ w`` idiom. A name REbound through a
+        cast call does not count."""
+        param: Set[str] = set()
+        cast: Set[str] = set()
+        for node in own_body_walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if cls._is_cast_call(node.value):
+                    cast.add(target.id)
+                elif cls._is_param_subscript(node.value):
+                    param.add(target.id)
+        return param - cast
+
+    @staticmethod
+    def _is_cast_call(expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        d = dotted(expr.func)
+        return bool(d) and d.split(".")[-1] in CAST_CALL_NAMES
+
+    @staticmethod
+    def _is_param_subscript(expr: ast.AST) -> bool:
+        """String-keyed subscript — the pytree-leaf access idiom
+        (``params['embed']``, ``blk['mlp']['w1']``). Integer/variable
+        indexing (batch gathers like ``xs[i]``) is data, not params."""
+        return (isinstance(expr, ast.Subscript)
+                and isinstance(expr.slice, ast.Constant)
+                and isinstance(expr.slice.value, str))
+
+    @classmethod
+    def _uncast_param_ref(cls, expr: ast.AST,
+                          param_names: Set[str]) -> Optional[str]:
+        """The offending source text when ``expr`` reaches a param leaf
+        with no cast wrapper on the path; None when clean."""
+        if cls._is_cast_call(expr):
+            return None
+        if cls._is_param_subscript(expr):
+            return ast.unparse(expr) if hasattr(ast, "unparse") else "?"
+        if isinstance(expr, ast.Name) and expr.id in param_names:
+            return expr.id
+        # unwrap transparent transforms (reshape/transpose/indexing/
+        # unary) — a reshape does not change the operand's dtype
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            attr = d.split(".")[-1] if d else ""
+            if attr in ("reshape", "transpose", "ravel", "squeeze"):
+                base = (expr.func.value
+                        if isinstance(expr.func, ast.Attribute) else None)
+                if base is not None:
+                    return cls._uncast_param_ref(base, param_names)
+            return None  # any other call decides its own dtype
+        if isinstance(expr, ast.Subscript):
+            return cls._uncast_param_ref(expr.value, param_names)
+        if isinstance(expr, ast.UnaryOp):
+            return cls._uncast_param_ref(expr.operand, param_names)
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -1105,6 +1234,7 @@ class MarkerAuditRule(Rule):
 
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncRule(),
+    ImplicitF32PromotionRule(),
     RecompileHazardRule(),
     RngReuseRule(),
     LockDisciplineRule(),

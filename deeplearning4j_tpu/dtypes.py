@@ -5,6 +5,20 @@ Replaces the reference's single global buffer dtype
 global switch) with a TPU-appropriate mixed-precision policy: parameters kept
 in float32, compute optionally in bfloat16 so matmuls/convs hit the MXU at
 full rate, outputs/losses accumulated in float32.
+
+Two bf16 flavors:
+
+- ``mixed_bfloat16`` / ``bf16`` — per-use casts: params stay f32 everywhere
+  and every matmul operand passes through ``cast_compute``. Gradients come
+  back f32 (they are taken wrt the f32 leaves).
+- ``mixed_bf16`` — master weights: the training step derives ONE bf16
+  parameter copy per step (``compute_copy``) and runs forward/backward on
+  it, so the per-matmul ``cast_compute`` calls find leaves already in bf16
+  and become no-ops. Gradients come back bf16 and are upcast ONCE
+  (``master_grads``); the updater applies to the f32 masters, which are
+  what the program carries, donates, and checkpoints — the standard
+  large-model recipe (weight-update sharding, arXiv 2004.13336, assumes
+  exactly this f32-state/bf16-compute split).
 """
 
 from __future__ import annotations
@@ -18,11 +32,15 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class DtypePolicy:
-    """Immutable dtype policy triple."""
+    """Immutable dtype policy triple (plus the master-weights switch)."""
 
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
     output_dtype: jnp.dtype = jnp.float32
+    # master-weights mode: the train step runs forward/backward on a
+    # compute-dtype parameter copy derived once per step while the
+    # carried/donated/checkpointed state stays in param_dtype
+    master_weights: bool = False
 
     def cast_compute(self, x):
         return jnp.asarray(x, self.compute_dtype)
@@ -33,10 +51,52 @@ class DtypePolicy:
     def cast_param(self, x):
         return jnp.asarray(x, self.param_dtype)
 
+    def compute_copy(self, tree):
+        """Compute-dtype copy of a whole parameter pytree, derived ONCE
+        per optimizer step under the master-weights policy (identity
+        otherwise). Downstream ``cast_compute`` calls on its leaves are
+        no-ops, so the step stops re-casting the same f32 leaves at
+        every use site."""
+        if not self.master_weights:
+            return tree
+        import jax
+
+        return jax.tree_util.tree_map(self.cast_compute, tree)
+
+    def master_grads(self, tree):
+        """Upcast a gradient pytree to the param (master) dtype ONCE —
+        the single grad cast of the master-weights step (identity when
+        masters are off: grads already carry param_dtype). Everything
+        downstream — isfinite sentinel, telemetry norms, updater state
+        math — reads these f32 leaves."""
+        if not self.master_weights:
+            return tree
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda g: g.astype(self.param_dtype), tree)
+
+    def grad_zeros(self, params_tree):
+        """Gradient-accumulation buffers in the PARAM dtype: under the
+        master-weights policy microbatch grads come back bf16 and must
+        sum in f32 (bf16 accumulation loses ~8 mantissa bits per add);
+        for the single-dtype policies this is exactly ``zeros_like``."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), self.param_dtype),
+            params_tree)
+
 
 FLOAT32 = DtypePolicy(jnp.float32, jnp.float32, jnp.float32)
 # MXU-friendly: bf16 matmul inputs, f32 params/accumulation.
 MIXED_BF16 = DtypePolicy(jnp.float32, jnp.bfloat16, jnp.float32)
+# bf16 compute on a per-step parameter copy + f32 master weights and
+# updater state — the first-class mixed-precision TRAINING mode (the
+# per-use-cast MIXED_BF16 above remains for inference-ish surfaces and
+# backward compatibility)
+MIXED_BF16_MASTER = DtypePolicy(jnp.float32, jnp.bfloat16, jnp.float32,
+                                master_weights=True)
 # Double precision — used by gradient checks, mirroring the reference's
 # requirement that gradient checks run in double (SURVEY §4).
 FLOAT64 = DtypePolicy(jnp.float64, jnp.float64, jnp.float64)
@@ -71,6 +131,7 @@ def policy_from_name(name: str) -> DtypePolicy:
         "f32": FLOAT32,
         "mixed_bfloat16": MIXED_BF16,
         "bf16": MIXED_BF16,
+        "mixed_bf16": MIXED_BF16_MASTER,
         "float64": FLOAT64,
         "f64": FLOAT64,
     }

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -26,6 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import dtypes as dtypes_mod
+from deeplearning4j_tpu.analysis.annotations import traced
 from deeplearning4j_tpu.ops.attention import (
     dot_product_attention,
     grouped_query_attention,
@@ -195,21 +197,52 @@ class TransformerLM:
         return self
 
     # ------------------------------------------------------------------
-    def _attn_impl(self, t: Optional[int] = None) -> str:
-        """Resolve "auto": the Pallas kernel pays off on a real TPU at
-        long sequence length (measured v5e crossover ~4k); short sequences
-        and interpret-mode backends stay on the XLA-fused path."""
-        if self.attn_impl != "auto":
-            return self.attn_impl
+    def _head_dim_tiles(self) -> bool:
+        """True when head_dim maps onto the kernel's lane tiles: the
+        flash block shapes put head_dim on the minor (lane) axis, so a
+        sublane-aligned head_dim >= half a lane tile keeps the MXU fed
+        without pathological padding."""
+        head_dim = self.d_model // self.num_heads
+        return head_dim >= 64 and head_dim % 8 == 0
+
+    def _attn_impl(self, t: Optional[int] = None, *,
+                   train: bool = False) -> str:
+        """Resolve the attention path. ``DL4J_ATTN_IMPL`` (``flash`` /
+        ``xla`` / ``auto``) overrides the constructor; resolution happens
+        at trace time (a static choice per program — no recompile
+        hazard). "auto" then means:
+
+        - **training** (``train=True``): the Pallas flash kernel whenever
+          head_dim tiles — the fwd AND bwd kernels exist
+          (pallas/flash_attention.py) and keep the [t, t] score matrix in
+          VMEM both directions, so training never materializes
+          [b, h, t, t] f32 HBM traffic (the round-3 MFU gap's largest
+          single term). Interpret-mode backends (CPU tests) stay on XLA.
+        - **inference**: the measured v5e crossover — flash from t >= 4k
+          (short decode/prefill shapes stay on the XLA-fused path)."""
+        env = os.environ.get("DL4J_ATTN_IMPL", "").strip().lower()
+        impl = self.attn_impl
+        if env:
+            if env not in ("auto", "xla", "flash"):
+                raise ValueError(
+                    f"DL4J_ATTN_IMPL={env!r} must be one of "
+                    "auto/xla/flash")
+            impl = env
+        if impl != "auto":
+            return impl
+        if flash_default_interpret():
+            return "xla"
+        if train:
+            return "flash" if self._head_dim_tiles() else "xla"
         seq = t if t is not None else self.max_len
-        if (not flash_default_interpret()
-                and seq >= 4096 and self.d_model // self.num_heads >= 64):
+        if seq >= 4096 and self.d_model // self.num_heads >= 64:
             return "flash"
         return "xla"
 
+    @traced
     def _block(self, blk, h, *, mesh: Optional[Mesh] = None,
                sequence_parallel: bool = False, attention=None,
-               positions=None):
+               positions=None, train: bool = False):
         """One pre-norm block on ``h`` [b, t, D]. Returns ``(h, k, v)``
         with k/v in [b, t, H, Dh] — ``forward`` discards them (XLA DCE),
         the KV-cache prefill keeps them (k/v are post-RoPE under
@@ -248,9 +281,9 @@ class TransformerLM:
             else:
                 o = ring_attention(q, self._repeat_kv(k),
                                    self._repeat_kv(v), mesh, causal=True,
-                                   impl=self._attn_impl(t),
+                                   impl=self._attn_impl(t, train=train),
                                    window=self.attn_window)
-        elif self._attn_impl(t) == "flash":
+        elif self._attn_impl(t, train=train) == "flash":
             o = flash_attention(q, self._repeat_kv(k), self._repeat_kv(v),
                                 causal=True, window=self.attn_window)
         else:
@@ -274,8 +307,10 @@ class TransformerLM:
         return x if rep == 1 else jnp.repeat(x, rep, axis=2)
 
     def forward(self, params, tokens, *, mesh: Optional[Mesh] = None,
-                sequence_parallel: bool = False):
-        """tokens: [b, t] int32 → logits [b, t, V]."""
+                sequence_parallel: bool = False, train: bool = False):
+        """tokens: [b, t] int32 → logits [b, t, V]. ``train=True`` is the
+        training hot path: "auto" attention resolves to the flash kernel
+        whenever head_dim tiles (see ``_attn_impl``)."""
         policy = self.policy
         b, t = tokens.shape
         h = jnp.take(params["embed"], tokens, axis=0)
@@ -285,7 +320,8 @@ class TransformerLM:
 
         def block_fn(blk, h):
             return self._block(blk, h, mesh=mesh,
-                               sequence_parallel=sequence_parallel)[0]
+                               sequence_parallel=sequence_parallel,
+                               train=train)[0]
 
         if self.remat:
             block_fn = jax.checkpoint(block_fn)
@@ -304,10 +340,13 @@ class TransformerLM:
                 h = block_fn(blk, h)
         return policy.cast_output(self._unembed(params, h))
 
-    def loss(self, params, tokens, *, mesh=None, sequence_parallel=False):
+    @traced
+    def loss(self, params, tokens, *, mesh=None, sequence_parallel=False,
+             train: bool = False):
         """Next-token cross entropy (mean over positions)."""
         logits = self.forward(params, tokens, mesh=mesh,
-                              sequence_parallel=sequence_parallel)
+                              sequence_parallel=sequence_parallel,
+                              train=train)
         targets = tokens[:, 1:]
         logits = logits[:, :-1]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -315,18 +354,27 @@ class TransformerLM:
         return jnp.mean(nll)
 
     # ------------------------------------------------------------------
+    @traced
     def _step_body(self, *, mesh: Optional[Mesh] = None,
                    sequence_parallel: bool = False):
         """Un-jitted single optimizer step (shared by the per-step jit and
-        the fused multi-step scan)."""
+        the fused multi-step scan). Under the ``mixed_bf16``
+        master-weights policy the step derives ONE bf16 parameter copy
+        for forward/backward, upcasts the bf16 grads once, and applies
+        Adam to the carried f32 masters + f32 moments — the standard
+        f32-state/bf16-compute split; per-matmul ``cast_compute`` calls
+        inside ``_block`` become no-ops on the copy's leaves."""
         lr = self.lr
         b1, b2, eps = 0.9, 0.999, 1e-8
 
         def step(params, opt_state, tokens, step_count):
+            fwd_params = self.policy.compute_copy(params)
             loss, grads = jax.value_and_grad(
                 lambda p: self.loss(p, tokens, mesh=mesh,
-                                    sequence_parallel=sequence_parallel)
-            )(params)
+                                    sequence_parallel=sequence_parallel,
+                                    train=True)
+            )(fwd_params)
+            grads = self.policy.master_grads(grads)
             t = step_count.astype(jnp.float32) + 1.0
 
             def upd(p, g, s):

@@ -44,9 +44,11 @@ from deeplearning4j_tpu.nn.conf.preprocessors import (
 from deeplearning4j_tpu.nn.layers.base import get_layer_impl
 from deeplearning4j_tpu.nn.updater import (
     UpdaterSpec,
-    apply_updater,
+    flat_apply_safe,
+    grouped_apply_updaters,
     init_updater_state,
     lr_policy_scale,
+    per_layer_apply_updaters,
 )
 from deeplearning4j_tpu.ops.losses import compute_loss
 from deeplearning4j_tpu.perf.bucketing import (
@@ -306,20 +308,24 @@ class ComputationGraph:
 
     def _apply_updaters(self, params, updater_state, grads, iteration,
                         lr_scale_host=None):
-        """LR schedule + per-layer updater math + parameter update — the
-        tail every optimizer-step variant (plain, accumulated, guarded)
+        """LR schedule + updater math + parameter update — the tail
+        every optimizer-step variant (plain, accumulated, guarded)
         shares. ``lr_scale_host`` (a traced scalar, or None = 1) is the
-        host LR multiplier the ``halve_lr`` divergence policy adjusts."""
+        host LR multiplier the ``halve_lr`` divergence policy adjusts.
+        ONE flattened sweep per (spec, lr, dtype) leaf group instead of
+        a per-vertex Python loop (``grouped_apply_updaters``; bitwise
+        the per-layer math); heterogeneously-sharded state (TP/FSDP
+        placements) takes the per-layer fallback — GSPMD miscompiles
+        the ravel→concat→slice chain over mixed shardings (see
+        ``flat_apply_safe``). Under the master-weights policy ``params``
+        are the f32 masters and ``grads`` arrive already upcast."""
         scale = self._lr_scale(iteration, lr_scale_host)
-        new_params, new_updater = {}, {}
-        for name, spec in self.updater_specs.items():
-            steps_i, upd_i = apply_updater(
-                spec, grads[name], updater_state[name], scale,
-                iteration + 1)
-            new_params[name] = jax.tree_util.tree_map(
-                lambda p, s: p - s.astype(p.dtype), params[name], steps_i)
-            new_updater[name] = upd_i
-        return new_params, new_updater
+        items = list(self.updater_specs.items())
+        apply_fn = (grouped_apply_updaters
+                    if flat_apply_safe(self.params)
+                    else per_layer_apply_updaters)
+        return apply_fn(items, params, updater_state, grads, scale,
+                        iteration + 1)
 
     @traced
     def _loss_grads(self, params, net_state, inputs, labels,
@@ -341,9 +347,13 @@ class ComputationGraph:
         """One optimizer step (pure; shared by the per-batch jitted step
         and the fused TBPTT scan body)."""
         with dtypes_mod.policy_scope(self._policy):
+            # master-weights policy: ONE bf16 copy for forward/backward,
+            # grads upcast ONCE, updater applies to the f32 masters
+            fwd_params = self._policy.compute_copy(params)
             (loss, (new_net_state, new_rnn)), grads = self._loss_grads(
-                params, net_state, inputs, labels, feature_masks,
+                fwd_params, net_state, inputs, labels, feature_masks,
                 label_masks, rng, rnn_state)
+            grads = self._policy.master_grads(grads)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, new_rnn
@@ -395,15 +405,18 @@ class ComputationGraph:
         def body(carry, inp):
             gsum, lsum, nst_in = carry
             # grads wrt params only; net_state threads through the
-            # carry so no microbatch's state update is dropped
+            # carry so no microbatch's state update is dropped.
+            # Accumulation buffers carry the PARAM dtype (bf16 micro-
+            # batch grads upcast into the f32 sum — see MLN counterpart)
             (lval, st), g = jax.value_and_grad(
                 micro_loss, has_aux=True)(
                 params, nst_in, inp["x"], inp["y"], inp.get("fm"),
                 inp["lm"], inp["rng"])
-            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            gsum = jax.tree_util.tree_map(
+                lambda s, gg: s + gg.astype(s.dtype), gsum, g)
             return (gsum, lsum + lval, st), None
 
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        zeros = self._policy.grad_zeros(params)
         (grads, loss, new_net_state), _ = jax.lax.scan(
             body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
         return grads, loss, new_net_state
@@ -421,8 +434,8 @@ class ComputationGraph:
         order. One updater apply."""
         with dtypes_mod.policy_scope(self._policy):
             grads, loss, new_net_state = self._accum_loss_grads(
-                params, net_state, inputs, labels, feature_masks,
-                label_masks, rng, accum_steps)
+                self._policy.compute_copy(params), net_state, inputs,
+                labels, feature_masks, label_masks, rng, accum_steps)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration)
         return new_params, new_updater, new_net_state, loss, None
@@ -440,14 +453,17 @@ class ComputationGraph:
         from deeplearning4j_tpu.resilience.guard import tree_all_finite
 
         with dtypes_mod.policy_scope(self._policy):
+            fwd_params = self._policy.compute_copy(params)
             if accum_steps > 1:
                 grads, loss, nst2 = self._accum_loss_grads(
-                    params, net_state, inputs, labels, feature_masks,
+                    fwd_params, net_state, inputs, labels, feature_masks,
                     label_masks, rng, accum_steps)
             else:
                 (loss, (nst2, _)), grads = self._loss_grads(
-                    params, net_state, inputs, labels, feature_masks,
+                    fwd_params, net_state, inputs, labels, feature_masks,
                     label_masks, rng)
+            # sentinel reads the f32 (master) grads post-upcast
+            grads = self._policy.master_grads(grads)
             ok = jnp.isfinite(loss) & tree_all_finite(grads)
 
             def apply(_):
@@ -480,14 +496,17 @@ class ComputationGraph:
         from deeplearning4j_tpu.resilience.guard import tree_all_finite
 
         with dtypes_mod.policy_scope(self._policy):
+            fwd_params = self._policy.compute_copy(params)
             if accum_steps > 1:
                 grads, loss, nst2 = self._accum_loss_grads(
-                    params, net_state, inputs, labels, feature_masks,
+                    fwd_params, net_state, inputs, labels, feature_masks,
                     label_masks, rng, accum_steps)
             else:
                 (loss, (nst2, _)), grads = self._loss_grads(
-                    params, net_state, inputs, labels, feature_masks,
+                    fwd_params, net_state, inputs, labels, feature_masks,
                     label_masks, rng)
+            # telemetry norms + sentinel read the f32 (master) grads
+            grads = self._policy.master_grads(grads)
             if guard:
                 ok = jnp.isfinite(loss) & tree_all_finite(grads)
 

@@ -36,8 +36,11 @@ from deeplearning4j_tpu.nn.layers.base import get_layer_impl
 from deeplearning4j_tpu.nn.updater import (
     UpdaterSpec,
     apply_updater,
+    flat_apply_safe,
+    grouped_apply_updaters,
     init_updater_state,
     lr_policy_scale,
+    per_layer_apply_updaters,
 )
 from deeplearning4j_tpu.ops.losses import compute_loss
 from deeplearning4j_tpu.perf.bucketing import (
@@ -220,20 +223,27 @@ class MultiLayerNetwork:
 
     def _apply_updaters(self, params, updater_state, grads, iteration,
                         lr_scale_host):
-        """LR schedule + per-layer updater math + parameter update — the
-        tail every optimizer-step variant (plain, accumulated) shares."""
+        """LR schedule + updater math + parameter update — the tail
+        every optimizer-step variant (plain, accumulated, guarded)
+        shares. ONE flattened sweep per (spec, lr, dtype) leaf group
+        instead of a per-layer Python loop, so the traced optimizer tail
+        is a fused region whose updater-math op count does not scale
+        with depth (``grouped_apply_updaters``; bitwise the per-layer
+        math). Heterogeneously-sharded state (tensor-parallel / FSDP
+        placements) takes the per-layer fallback — GSPMD miscompiles the
+        ravel→concat→slice chain over mixed shardings (see
+        ``flat_apply_safe``); the trace-time gate reads the LIVE params'
+        placements, consistent because jit re-traces per sharding.
+        Under the master-weights policy ``params`` are the f32 masters
+        and ``grads`` arrive already upcast to f32."""
         scale = self._lr_scale(iteration, lr_scale_host)
-        new_params, new_updater = {}, {}
-        for i, spec in enumerate(self.updater_specs):
-            si = str(i)
-            steps_i, upd_i = apply_updater(
-                spec, grads[si], updater_state[si], scale, iteration + 1
-            )
-            new_params[si] = jax.tree_util.tree_map(
-                lambda p, s: p - s.astype(p.dtype), params[si], steps_i
-            )
-            new_updater[si] = upd_i
-        return new_params, new_updater
+        items = [(str(i), spec)
+                 for i, spec in enumerate(self.updater_specs)]
+        apply_fn = (grouped_apply_updaters
+                    if flat_apply_safe(self.params)
+                    else per_layer_apply_updaters)
+        return apply_fn(items, params, updater_state, grads, scale,
+                        iteration + 1)
 
     @traced
     def _loss_grads(self, params, net_state, x, y, feature_mask,
@@ -255,9 +265,14 @@ class MultiLayerNetwork:
                    lr_scale_host, x, y, feature_mask, label_mask, rng,
                    rnn_state):
         with dtypes_mod.policy_scope(self._policy):
+            # master-weights policy: ONE bf16 copy for forward/backward,
+            # grads upcast ONCE, updater applies to the f32 masters
+            # (identity casts under the single-dtype policies)
+            fwd_params = self._policy.compute_copy(params)
             (loss, (new_net_state, new_rnn)), grads = self._loss_grads(
-                params, net_state, x, y, feature_mask, label_mask, rng,
-                rnn_state)
+                fwd_params, net_state, x, y, feature_mask, label_mask,
+                rng, rnn_state)
+            grads = self._policy.master_grads(grads)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, new_rnn, loss
@@ -302,15 +317,19 @@ class MultiLayerNetwork:
         def body(carry, inp):
             gsum, lsum, nst_in = carry
             # grads wrt params only (argnum 0); net_state threads
-            # through the carry so NO microbatch's update is dropped
+            # through the carry so NO microbatch's update is dropped.
+            # Accumulation buffers carry the PARAM dtype: bf16
+            # microbatch grads (master-weights policy) upcast into the
+            # f32 sum instead of summing in bf16
             (lval, st), g = jax.value_and_grad(
                 micro_loss, has_aux=True)(
                 params, nst_in, inp["x"], inp["y"], inp.get("fm"),
                 inp["lm"], inp["rng"])
-            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            gsum = jax.tree_util.tree_map(
+                lambda s, gg: s + gg.astype(s.dtype), gsum, g)
             return (gsum, lsum + lval, st), None
 
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        zeros = self._policy.grad_zeros(params)
         (grads, loss, new_net_state), _ = jax.lax.scan(
             body, (zeros, jnp.zeros((), jnp.float32), net_state), seq)
         return grads, loss, new_net_state
@@ -331,8 +350,8 @@ class MultiLayerNetwork:
         per-microbatch updates instead of one full-batch update."""
         with dtypes_mod.policy_scope(self._policy):
             grads, loss, new_net_state = self._accum_loss_grads(
-                params, net_state, x, y, feature_mask, label_mask, rng,
-                accum_steps)
+                self._policy.compute_copy(params), net_state, x, y,
+                feature_mask, label_mask, rng, accum_steps)
             new_params, new_updater = self._apply_updaters(
                 params, updater_state, grads, iteration, lr_scale_host)
         return new_params, new_updater, new_net_state, None, loss
@@ -355,14 +374,18 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.resilience.guard import tree_all_finite
 
         with dtypes_mod.policy_scope(self._policy):
+            fwd_params = self._policy.compute_copy(params)
             if accum_steps > 1:
                 grads, loss, nst2 = self._accum_loss_grads(
-                    params, net_state, x, y, feature_mask, label_mask,
+                    fwd_params, net_state, x, y, feature_mask, label_mask,
                     rng, accum_steps)
             else:
                 (loss, (nst2, _)), grads = self._loss_grads(
-                    params, net_state, x, y, feature_mask, label_mask,
+                    fwd_params, net_state, x, y, feature_mask, label_mask,
                     rng)
+            # sentinel reads the f32 grads (post-upcast): a bf16 overflow
+            # to inf is preserved by the widening cast
+            grads = self._policy.master_grads(grads)
             ok = jnp.isfinite(loss) & tree_all_finite(grads)
 
             def apply(_):
@@ -394,14 +417,17 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.resilience.guard import tree_all_finite
 
         with dtypes_mod.policy_scope(self._policy):
+            fwd_params = self._policy.compute_copy(params)
             if accum_steps > 1:
                 grads, loss, nst2 = self._accum_loss_grads(
-                    params, net_state, x, y, feature_mask, label_mask,
+                    fwd_params, net_state, x, y, feature_mask, label_mask,
                     rng, accum_steps)
             else:
                 (loss, (nst2, _)), grads = self._loss_grads(
-                    params, net_state, x, y, feature_mask, label_mask,
+                    fwd_params, net_state, x, y, feature_mask, label_mask,
                     rng)
+            # telemetry norms + sentinel read the f32 (master) grads
+            grads = self._policy.master_grads(grads)
             if guard:
                 ok = jnp.isfinite(loss) & tree_all_finite(grads)
 

@@ -254,6 +254,173 @@ def apply_updater(
 
 
 # ---------------------------------------------------------------------------
+# Flattened (grouped) updater apply — the fused optimizer tail
+# ---------------------------------------------------------------------------
+
+
+def flat_apply_safe(live_params) -> bool:
+    """True when the live parameter leaves all carry the SAME placement,
+    making the flattened (concat) updater sweep safe to trace.
+
+    GSPMD miscompiles a ravel→concat→slice chain over leaves with
+    HETEROGENEOUS shardings (verified on jax 0.4.37: a 15-line
+    concat-of-(P(None,'model'), P('model'), P()) repro returns wrong
+    values under jit while eager is exact), so tensor-parallel and
+    FSDP-sharded state must take the per-layer apply instead. The
+    decision is made at TRACE time from the network's live (concrete)
+    params — consistent with the traced call because jit re-traces
+    whenever input shardings change."""
+    shardings = set()
+    for leaf in jax.tree_util.tree_leaves(live_params):
+        s = getattr(leaf, "sharding", None)
+        if s is None:
+            return False  # tracer/host array: no placement info → safe path
+        try:
+            shardings.add(s)
+        except TypeError:  # unhashable sharding object
+            return False
+        if len(shardings) > 1:
+            return False
+    return True
+
+
+def per_layer_apply_updaters(items, params, updater_state, grads,
+                             lr_scale, step_count):
+    """The classic per-layer loop (one :func:`apply_updater` per layer)
+    — the sharding-agnostic fallback of :func:`grouped_apply_updaters`,
+    factored out of both network classes. Same math, L unrolled
+    copies."""
+    new_params, new_updater = {}, {}
+    for key, spec in items:
+        steps_i, upd_i = apply_updater(
+            spec, grads[key], updater_state[key], lr_scale, step_count)
+        new_params[key] = jax.tree_util.tree_map(
+            lambda p, s: p - s.astype(p.dtype), params[key], steps_i)
+        new_updater[key] = upd_i
+    return new_params, new_updater
+
+
+def _cat_flat(leaves):
+    """Concatenate arrays as one flat vector (identity-ish for one)."""
+    flats = [l.reshape(-1) for l in leaves]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
+def _iter_leaf_records(grads, state, params, path=()):
+    """Yield ``(path, g, s, p)`` per param leaf of one layer's subtree.
+    ``s`` is that leaf's updater-state slot: an array (SGD/AdaGrad/
+    RMSProp/Nesterovs) or a dict of arrays (Adam/AdaDelta)."""
+    for name in sorted(grads):
+        g = grads[name]
+        if isinstance(g, dict):  # nested (e.g. biLSTM fwd/bwd)
+            yield from _iter_leaf_records(g, state[name], params[name],
+                                          path + (name,))
+        else:
+            yield path + (name,), g, state[name], params[name]
+
+
+def grouped_apply_updaters(items, params, updater_state, grads, lr_scale,
+                           step_count):
+    """The whole multi-layer optimizer tail as ONE flattened sweep.
+
+    ``items`` is the ordered ``(layer_key, spec)`` list; ``params`` /
+    ``updater_state`` / ``grads`` are the per-layer-keyed pytrees. Param
+    leaves are grouped by ``(spec, effective lr, dtype)``, each group's
+    leaves raveled into ONE flat vector, and :func:`_apply_one` runs once
+    per group — so the traced updater math (the Adam/Nesterovs/... op
+    chain XLA must schedule) is per-GROUP, not per-leaf: depth-invariant
+    for the common one-updater network instead of L unrolled copies. The
+    per-leaf residue is only trivial reshape/slice data movement that XLA
+    fuses into the surrounding program.
+
+    Exactly the math of the per-layer :func:`apply_updater` loop: the
+    updater ops are elementwise, so concat → op → split is bitwise the
+    per-leaf op, and per-layer gradient NORMALIZATION (whose norms are
+    defined over one layer's gradient) still runs per layer before
+    grouping. ``bias_learning_rate`` leaves split into their own group.
+
+    Returns ``(new_params, new_updater_state)`` with the input pytree
+    structure (donation-compatible round-trip).
+    """
+    from deeplearning4j_tpu.nn.layers.base import is_bias_param
+
+    t = jnp.maximum(step_count, 1).astype(jnp.float32)
+    groups: Dict[Any, list] = {}
+    order = []
+    new_params: Dict[str, Any] = {}
+    new_updater: Dict[str, Any] = {}
+    for key, spec in items:
+        # structure skeletons so empty layers round-trip too
+        new_params[key] = _skeleton(params[key])
+        new_updater[key] = _skeleton(updater_state[key])
+        g_layer = grads[key]
+        if spec.gradient_normalization != GradientNormalization.NONE:
+            # norms are per-LAYER by definition — normalize before the
+            # cross-layer grouping so semantics match the per-layer loop
+            g_layer = normalize_gradients(spec, g_layer)
+        for path, g, s, p in _iter_leaf_records(
+                g_layer, updater_state[key], params[key]):
+            lr = spec.learning_rate
+            if (spec.bias_learning_rate is not None
+                    and is_bias_param(path[-1])):
+                lr = spec.bias_learning_rate
+            gk = (spec, lr, str(g.dtype))
+            if gk not in groups:
+                groups[gk] = []
+                order.append(gk)
+            groups[gk].append((key, path, g, s, p))
+
+    for gk in order:
+        spec, lr, _ = gk
+        recs = groups[gk]
+        flat_g = _cat_flat([g for _, _, g, _, _ in recs])
+        s0 = recs[0][3]
+        if isinstance(s0, dict):
+            flat_s = {k2: _cat_flat([s[k2] for _, _, _, s, _ in recs])
+                      for k2 in sorted(s0)}
+        else:
+            flat_s = _cat_flat([s for _, _, _, s, _ in recs])
+        step_flat, s2_flat = _apply_one(spec, lr * lr_scale, flat_g,
+                                        flat_s, t)
+        off = 0
+        state_offs = ({k2: 0 for k2 in sorted(s0)}
+                      if isinstance(s0, dict) else 0)
+        for key, path, g, s, p in recs:
+            size = int(g.size)
+            leaf_step = step_flat[off:off + size].reshape(g.shape)
+            off += size
+            _put(new_params[key], path, p - leaf_step.astype(p.dtype))
+            if isinstance(s, dict):
+                slot = {}
+                for k2 in sorted(s):
+                    ssz = int(s[k2].size)
+                    so = state_offs[k2]
+                    slot[k2] = s2_flat[k2][so:so + ssz].reshape(
+                        s[k2].shape)
+                    state_offs[k2] = so + ssz
+            else:
+                ssz = int(s.size)
+                slot = s2_flat[state_offs:state_offs + ssz].reshape(
+                    s.shape)
+                state_offs += ssz
+            _put(new_updater[key], path, slot)
+    return new_params, new_updater
+
+
+def _skeleton(tree):
+    if isinstance(tree, dict):
+        return {k: _skeleton(v) for k, v in tree.items()}
+    return tree  # leaf placeholder, overwritten by _put
+
+
+def _put(root, path, value):
+    node = root
+    for part in path[:-1]:
+        node = node[part]
+    node[path[-1]] = value
+
+
+# ---------------------------------------------------------------------------
 # Learning-rate policies (nn/conf/LearningRatePolicy)
 # ---------------------------------------------------------------------------
 

@@ -49,10 +49,22 @@ WEDGE_MARKERS = ("backend unavailable", "wedge", "did not complete")
 TRACKED = [
     ("headline", lambda r: r["value"] if r["status"] == "ok" else None,
      "higher"),
+    # the scored MFU series is COST-ANALYSIS-ONLY: rounds whose MFU was
+    # derived from the analytic formula (pre-PR-8 artifacts, or a round
+    # where cost analysis was unavailable) return None and never enter
+    # the trajectory — an analytic number comparing against a compiled
+    # one is not the same experiment (the table flags such rounds)
     ("transformer_mfu_pct",
-     lambda r: _dig(r, "transformer_lm", "mfu_pct"), "higher"),
+     lambda r: (_dig(r, "transformer_lm", "mfu_pct")
+                if transformer_flops_source(r) == "cost_analysis"
+                else None), "higher"),
     ("transformer_tokens_per_sec",
      lambda r: _dig(r, "transformer_lm", "tokens_per_sec"), "higher"),
+    # mixed-precision step speedup (bf16 step vs the f32-policy step at
+    # the same config) — the PR-14 MFU push's direct evidence
+    ("train_step_bf16_speedup",
+     lambda r: _dig(r, "transformer_lm", "train_step_bf16_speedup"),
+     "higher"),
     ("resnet18_mfu_pct",
      lambda r: _dig(r, "resnet18_cifar10", "mfu_pct"), "higher"),
     ("resnet18_samples_per_sec",
@@ -129,6 +141,22 @@ def _dig(row: dict, section: str, field: str):
         return None
     val = sec.get(field)
     return float(val) if isinstance(val, (int, float)) else None
+
+
+def transformer_flops_source(row: dict):
+    """Where the round's transformer MFU FLOPs came from:
+    ``"cost_analysis"`` (the PR-8 dual block with a non-null compiled
+    count), ``"analytic"`` (a legacy string block, or a dual block whose
+    cost-analysis capture failed), or None (no transformer data)."""
+    sec = (row.get("extras") or {}).get("transformer_lm")
+    if not isinstance(sec, dict) or "error" in sec:
+        return None
+    src = sec.get("flops_source")
+    if isinstance(src, dict):
+        return ("cost_analysis"
+                if src.get("cost_analysis_flops") is not None
+                else "analytic")
+    return "analytic" if src is not None else None
 
 
 def _dig_ledger(row: dict, field: str = "goodput_pct"):
@@ -275,6 +303,13 @@ def print_table(rows: List[dict], out=None) -> None:
             "note"]
     table = []
     for row in rows:
+        note = row["note"]
+        if (row["status"] == "ok"
+                and transformer_flops_source(row) == "analytic"):
+            # the MFU printed beside it came from the hand formula, not
+            # the compiled program — excluded from the scored series
+            flag = "[flops_source!=cost_analysis]"
+            note = f"{note} {flag}".strip() if note else flag
         table.append([
             f"r{row['round']:02d}" if row["round"] is not None else "?",
             row["status"].upper() if row["status"] != "ok" else "ok",
@@ -286,7 +321,7 @@ def print_table(rows: List[dict], out=None) -> None:
             _fmt(_dig(row, *OVERHEADS[1][1])),
             _fmt(_dig_ledger(row)),
             _badput_note(row) or "-",
-            row["note"],
+            note,
         ])
     widths = [max(len(str(r[i])) for r in [cols] + table)
               for i in range(len(cols))]
@@ -334,6 +369,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "note": row["note"],
                 "goodput_pct": _dig_ledger(row),
                 "badput": _dig_ledger(row, "badput"),
+                "transformer_flops_source": transformer_flops_source(row),
             }
             for label, extract, _direction in TRACKED[1:]:
                 entry[label] = extract(row)

@@ -55,10 +55,19 @@
 #                                      over the committed BENCH_r*.json
 #                                      trajectory; nonzero exit on a
 #                                      bench regression)
-# The eval/epoch/dp/heal/obs/serve/fleet/lint/profile tests are part of
-# the default tier-1 run; --eval/--epoch/--dp/--heal/--obs/--serve/
-# --fleet/--lint/--profile are the narrow fast paths for iterating on
-# those surfaces.
+#        scripts/verify.sh --mfu      (mixed-precision MFU push: the
+#                                      mixed_bf16 master-weights suite —
+#                                      fused-epoch loss parity vs f32,
+#                                      flash-vs-xla training parity,
+#                                      preempt→resume master round-trip,
+#                                      fused updater-sweep depth
+#                                      invariance, contracts over the
+#                                      mixed program — plus the
+#                                      implicit-f32-promotion lint)
+# The eval/epoch/dp/heal/obs/serve/fleet/lint/profile/mfu tests are part
+# of the default tier-1 run; --eval/--epoch/--dp/--heal/--obs/--serve/
+# --fleet/--lint/--profile/--mfu are the narrow fast paths for iterating
+# on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -119,6 +128,13 @@ elif [ "${1:-}" = "--profile" ]; then
     # must show no silent round-over-round regression (wedge/error
     # rounds are called out but never scored)
     python scripts/bench_report.py --check BENCH_r*.json || exit 1
+elif [ "${1:-}" = "--mfu" ]; then
+    shift
+    TARGET=tests/test_mixed_precision.py
+    # the promotion lint rides along: no matmul operand in a traced hot
+    # path may reach a param leaf without policy.cast_compute (the bug
+    # class that silently runs the bf16 step at f32 MXU rate)
+    python scripts/dl4j_lint.py --select implicit-f32-promotion || exit 1
 fi
 
 rm -f /tmp/_t1.log

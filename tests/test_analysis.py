@@ -198,6 +198,94 @@ class TestHostSyncRule:
 
 
 # ---------------------------------------------------------------------------
+# implicit-f32-promotion
+# ---------------------------------------------------------------------------
+
+
+class TestImplicitF32PromotionRule:
+    """A matmul/einsum operand reaching a param leaf without
+    ``policy.cast_compute`` inside a traced hot path — the bug class
+    that already shipped once (the transformer residual-stream f32
+    promotion under the bf16 policy)."""
+
+    def test_seeded_raw_leaf_operand(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            from deeplearning4j_tpu.analysis.annotations import traced
+
+            @traced
+            def _block(self, blk, h):
+                return h @ blk["attn"]["wq"]
+            """, rule="implicit-f32-promotion")
+        assert len(found) == 1
+        assert "blk['attn']['wq']" in found[0].message
+        assert found[0].symbol == "_block"
+
+    def test_seeded_bound_name_and_einsum(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            @traced
+            def _step_impl(params, x):
+                w1 = params["mlp"]["w1"]
+                a = x @ w1
+                b = jnp.einsum("bd,df->bf", a, params["w3"])
+                return a + b
+            """, rule="implicit-f32-promotion")
+        assert len(found) == 2
+        assert {"w1" in f.message or "w3" in f.message
+                for f in found} == {True}
+
+    def test_seeded_in_hot_registry_root(self, tmp_path):
+        # HOT_PATH_REGISTRY names are hot without the decorator
+        found = lint_snippet(tmp_path, """
+            def _epoch_run_fn(self, params, x):
+                return lax.dot_general(x, params["W"], dims)
+            """, rule="implicit-f32-promotion")
+        assert len(found) == 1
+
+    def test_cast_compute_wrapped_operand_is_clean(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            @traced
+            def _block(self, policy, blk, h):
+                q = h @ policy.cast_compute(blk["attn"]["wq"])
+                w = policy.cast_compute(blk["mlp"]["w1"])
+                z = q @ w
+                return z @ blk["out"]["w2"].astype(h.dtype)
+            """, rule="implicit-f32-promotion")
+        assert found == []
+
+    def test_cold_function_and_data_subscripts_are_clean(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            def cold(params, x):
+                return x @ params["W"]   # not reachable from a hot root
+
+            @traced
+            def _step_impl(xs, i, w_cast):
+                return xs[i] @ w_cast    # integer gather = data, not params
+            """, rule="implicit-f32-promotion")
+        assert found == []
+
+    def test_suppressed_with_reason_is_muted(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            @traced
+            def _step_impl(params, x):
+                return x @ params["W"]  # dl4j-lint: disable=implicit-f32-promotion -- f64 gradient-check path, promotion intended
+            """, rule="implicit-f32-promotion")
+        assert found == []
+
+    def test_shipped_tree_is_clean(self):
+        # the matmul-heavy hot surfaces; the default full-tree CLI run
+        # in this suite already covers the rule over everything else
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--select",
+             "implicit-f32-promotion",
+             os.path.join(REPO, "deeplearning4j_tpu", "models"),
+             os.path.join(REPO, "deeplearning4j_tpu", "nn"),
+             os.path.join(REPO, "deeplearning4j_tpu", "serving"),
+             os.path.join(REPO, "deeplearning4j_tpu", "pallas")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
 # recompile-hazard
 # ---------------------------------------------------------------------------
 

@@ -611,12 +611,46 @@ class TestBenchReport:
 
     def test_section_metrics_are_tracked(self, tmp_path):
         """A regression hiding in a section (headline steady) is still
-        caught — the satellite metrics feed the gate too."""
+        caught — the satellite metrics feed the gate too. The MFU series
+        engages only for cost-analysis-sourced rounds (PR 14)."""
+        cost = {"flops_source": {"cost_analysis_flops": 1.0e9}}
         files = [
             _write_round(tmp_path, 1, 100.0,
-                         extras={"transformer_lm": {"mfu_pct": 8.0}}),
+                         extras={"transformer_lm": {"mfu_pct": 8.0,
+                                                    **cost}}),
             _write_round(tmp_path, 2, 101.0,
-                         extras={"transformer_lm": {"mfu_pct": 2.0}}),
+                         extras={"transformer_lm": {"mfu_pct": 2.0,
+                                                    **cost}}),
+        ]
+        assert bench_report.main(["--check"] + files) == 1
+
+    def test_analytic_mfu_rounds_never_enter_the_series(self, tmp_path,
+                                                        capsys):
+        """flops_source != cost_analysis ⇒ the round's MFU is not a
+        trajectory point (an analytic number must never baseline or
+        regress the compiled-FLOPs series) and the table flags it."""
+        files = [
+            _write_round(tmp_path, 1, 100.0,
+                         extras={"transformer_lm": {
+                             "mfu_pct": 8.0,
+                             "flops_source": "analytic 6*N/token"}}),
+            _write_round(tmp_path, 2, 101.0,
+                         extras={"transformer_lm": {
+                             "mfu_pct": 2.0,
+                             "flops_source": {
+                                 "cost_analysis_flops": None}}}),
+        ]
+        assert bench_report.main(["--check"] + files) == 0
+        assert "[flops_source!=cost_analysis]" in capsys.readouterr().out
+
+    def test_bf16_speedup_is_tracked(self, tmp_path):
+        files = [
+            _write_round(tmp_path, 1, 100.0,
+                         extras={"transformer_lm": {
+                             "train_step_bf16_speedup": 1.8}}),
+            _write_round(tmp_path, 2, 101.0,
+                         extras={"transformer_lm": {
+                             "train_step_bf16_speedup": 1.0}}),
         ]
         assert bench_report.main(["--check"] + files) == 1
 

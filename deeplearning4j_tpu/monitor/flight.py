@@ -423,6 +423,10 @@ def flight_record(kind: str, **payload) -> None:
 _NON_PROGRESS_KINDS = ("flight.heartbeat",)
 #: span/event names that are evidence of a stuck (not dead) process
 WEDGE_EVIDENCE_NAMES = ("watchdog.stall", "grant.watchdog")
+#: span/event names that mark a grant-lease RESCUE: the grant wedged and
+#: was re-acquired (resilience/lease.py). A run that then finishes clean
+#: classifies as ``reacquired`` — clean-with-recovery, not wedged.
+REACQUIRE_EVIDENCE_NAMES = ("grant.reacquired",)
 #: factor of the heartbeat interval after which continued beats with no
 #: progress classify as a wedge
 WEDGE_SILENCE_FACTOR = 3.0
@@ -460,6 +464,13 @@ def _is_wedge_evidence(rec: dict) -> bool:
             and rec.get("name") in WEDGE_EVIDENCE_NAMES)
 
 
+def _is_reacquire_evidence(rec: dict) -> bool:
+    if rec.get("kind") in REACQUIRE_EVIDENCE_NAMES:
+        return True
+    return (rec.get("kind") == "span"
+            and rec.get("name") in REACQUIRE_EVIDENCE_NAMES)
+
+
 def _is_progress(rec: dict) -> bool:
     return (rec.get("kind") not in _NON_PROGRESS_KINDS
             and not _is_wedge_evidence(rec))
@@ -486,6 +497,11 @@ def classify_end_state(records: List[dict],
     - ``crashed``   — records stop abruptly (heartbeats die with the
       progress), or the run closed with an error status: the process
       (or the program) died mid-work.
+    - ``reacquired`` — an otherwise-clean ending whose timeline carries
+      ``grant.reacquired`` evidence: a grant wedged mid-run and the
+      lease rescued it. Operationally clean-with-recovery — the round
+      survived — but flagged so a fleet quietly re-acquiring every run
+      is visible, not folded into ``clean``.
     """
     if not records:
         return {"end_state": "unknown", "evidence": "no records survived"}
@@ -532,6 +548,11 @@ def classify_end_state(records: List[dict],
                     "status": status}
         if str(status).startswith("error"):
             return {"end_state": "crashed", "evidence": evidence,
+                    "status": status}
+        reacquires = sum(1 for r in records if _is_reacquire_evidence(r))
+        if reacquires:
+            evidence["n_reacquires"] = reacquires
+            return {"end_state": "reacquired", "evidence": evidence,
                     "status": status}
         return {"end_state": "clean", "evidence": evidence,
                 "status": status}

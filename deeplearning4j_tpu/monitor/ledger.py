@@ -23,7 +23,8 @@ states by priority:
 | ``retry_backoff`` | ``retry.sleep`` |
 | ``watchdog_stall`` | ``watchdog.stall`` events (interval re-derived from ``stalled_s``) |
 | ``preemption_recovery`` | ``checkpoint.resume`` |
-| ``grant_wait`` | ``grant.probe`` / ``grant.acquire`` / ``grant.subprocess`` |
+| ``reshard`` | ``reshard.elastic`` — the chunk-boundary device snapshot → respec → continue of a mid-run mesh grow/shrink |
+| ``grant_wait`` | ``grant.probe`` / ``grant.acquire`` / ``grant.reacquire`` / ``grant.backoff`` / ``grant.subprocess`` — including every lease re-acquire cycle, so a rescued wedge is booked as grant badput instead of a lost round |
 | ``idle`` | outside any run window and any classified span |
 
 Goodput % is ``compute / (window − idle)``; the badput breakdown is the
@@ -59,8 +60,11 @@ BADPUT_SPAN_STATES = {
     "checkpoint.snapshot": "checkpoint",
     "checkpoint.resume": "preemption_recovery",
     "retry.sleep": "retry_backoff",
+    "reshard.elastic": "reshard",
     "grant.probe": "grant_wait",
     "grant.acquire": "grant_wait",
+    "grant.reacquire": "grant_wait",
+    "grant.backoff": "grant_wait",
     "grant.subprocess": "grant_wait",
 }
 
@@ -71,10 +75,11 @@ STATE_PRIORITY = {
     GOODPUT_STATE: 1,
     "cache_build": 2,
     "checkpoint": 3,
-    "retry_backoff": 4,
-    "watchdog_stall": 5,
-    "preemption_recovery": 6,
-    "grant_wait": 7,
+    "reshard": 4,
+    "retry_backoff": 5,
+    "watchdog_stall": 6,
+    "preemption_recovery": 7,
+    "grant_wait": 8,
 }
 
 BADPUT_STATES = tuple(s for s in STATE_PRIORITY

@@ -63,6 +63,7 @@ from deeplearning4j_tpu.perf.epoch_cache import (
     accum_steps_default,
     drive_epoch_chunks,
     effective_accum_steps,
+    elastic_reshard,
     epoch_schedule,
     stream_epochs,
 )
@@ -734,6 +735,11 @@ class ComputationGraph:
         self.updater_state = jax.device_put(self.updater_state, repl)
         self.net_state = jax.device_put(self.net_state, repl)
 
+    def request_reshard(self, mesh) -> None:
+        """Request a chunk-boundary elastic reshard of the in-flight
+        ``fit_epochs`` run (see MultiLayerNetwork.request_reshard)."""
+        self._pending_mesh = (mesh,)
+
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
                    cache_mb: Optional[float] = None, mesh=None,
@@ -818,7 +824,9 @@ class ComputationGraph:
         return drive_epoch_chunks(self, cache, num_epochs, chunk_epochs,
                                   launch, shuffle=shuffle, guard=guard,
                                   replay_step=replay_step,
-                                  on_chunk=on_chunk)
+                                  on_chunk=on_chunk,
+                                  reshard=lambda m: elastic_reshard(
+                                      self, cache, m))
 
     @functools.cached_property
     def _output_fn(self):

@@ -53,6 +53,7 @@ from deeplearning4j_tpu.perf.epoch_cache import (
     accum_steps_default,
     drive_epoch_chunks,
     effective_accum_steps,
+    elastic_reshard,
     epoch_schedule,
     stream_epochs,
 )
@@ -721,6 +722,18 @@ class MultiLayerNetwork:
         self.updater_state = jax.device_put(self.updater_state, repl)
         self.net_state = jax.device_put(self.net_state, repl)
 
+    def request_reshard(self, mesh) -> None:
+        """Request a mid-run elastic reshard of the in-flight
+        ``fit_epochs`` run: at the NEXT chunk boundary the driver
+        snapshots the trainable state to host, re-places it (and the
+        dataset cache) on ``mesh`` (``None`` = back to one device), and
+        continues — no checkpoint round trip, cursor/RNG/updater state
+        carried exactly, final params <= 1e-6 of the uninterrupted run
+        (all-reduce summation order only). This is what a goodput
+        autopilot's caller-wired ``reshard`` actuator should call; idle
+        networks simply apply it on their next fused run."""
+        self._pending_mesh = (mesh,)
+
     def fit_epochs(self, data, num_epochs: int, *, shuffle: bool = True,
                    chunk_epochs: Optional[int] = None,
                    cache_mb: Optional[float] = None, mesh=None,
@@ -845,7 +858,9 @@ class MultiLayerNetwork:
         return drive_epoch_chunks(self, cache, num_epochs, chunk_epochs,
                                   launch, shuffle=shuffle, guard=guard,
                                   replay_step=replay_step,
-                                  on_chunk=on_chunk)
+                                  on_chunk=on_chunk,
+                                  reshard=lambda m: elastic_reshard(
+                                      self, cache, m))
 
     def _sgd_step(self, ds, rnn_state=None):
         self._train_dispatches += 1

@@ -139,6 +139,13 @@ class StateTracker:
         jobs (MasterActor.java:141-171: 120 s stale-worker eviction)."""
         raise NotImplementedError
 
+    def evict_worker(self, worker_id: str) -> bool:
+        """Evict ONE named worker regardless of beat age and requeue its
+        claimed jobs — the autopilot's targeted-eviction primitive (a
+        flagged straggler is still beating, so ``evict_stale`` cannot
+        reach it). Returns True when the worker was registered."""
+        raise NotImplementedError
+
     # --- replicated key/value metadata (config registry role) ---
     def put_meta(self, key: str, value: Any) -> None:
         raise NotImplementedError
@@ -273,6 +280,17 @@ class InMemoryStateTracker(StateTracker):
                         j.status = "pending"
                         j.worker_id = None
             return stale
+
+    def evict_worker(self, worker_id: str) -> bool:
+        with self._lock:
+            known = worker_id in self._beats
+            self._beats.pop(worker_id, None)
+            self._beat_metrics.pop(worker_id, None)
+            for j in self._jobs.values():
+                if j.worker_id == worker_id and j.status == "claimed":
+                    j.status = "pending"
+                    j.worker_id = None
+            return known
 
     def put_meta(self, key: str, value: Any) -> None:
         with self._lock:
@@ -544,6 +562,31 @@ class FileStateTracker(StateTracker):
                 finally:
                     self._unlock("claim-" + j.job_id)
         return stale
+
+    def evict_worker(self, worker_id: str) -> bool:
+        known = worker_id in self.workers()
+        try:
+            os.unlink(self._beat_path(worker_id))
+        except FileNotFoundError:
+            pass
+        dead = {worker_id}
+        for j in self.jobs(status="claimed"):
+            if j.worker_id not in dead:
+                continue
+            # same claim-lock + status re-check as evict_stale: a
+            # merely-slow worker may complete the job concurrently
+            if not self._try_lock("claim-" + j.job_id):
+                continue
+            try:
+                cur = self._read_job(j.job_id)
+                if (cur is not None and cur.status == "claimed"
+                        and cur.worker_id in dead):
+                    cur.status = "pending"
+                    cur.worker_id = None
+                    self._write_job(cur)
+            finally:
+                self._unlock("claim-" + j.job_id)
+        return known
 
     # -- meta --
     def put_meta(self, key: str, value: Any) -> None:

@@ -21,6 +21,7 @@ instead (SURVEY §7.7a).
 
 from __future__ import annotations
 
+import logging
 import statistics
 import threading
 import time
@@ -30,6 +31,8 @@ import numpy as np
 
 from deeplearning4j_tpu.monitor import metrics, record_counter, tracer
 from deeplearning4j_tpu.parallel.statetracker import StateTracker
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -204,7 +207,8 @@ class DistributedTrainer:
                  max_attempts: int = 3, join_timeout_s: float = 60.0,
                  eviction_timeout_s: Optional[float] = None,
                  heartbeat_interval_s: float = 1.0,
-                 straggler_ratio: float = 3.0):
+                 straggler_ratio: float = 3.0,
+                 autopilot=None):
         self.tracker = tracker
         self.router = router
         self.performer_factory = performer_factory
@@ -229,18 +233,47 @@ class DistributedTrainer:
         # the fleet median gets flagged (>=3 reporting workers, so one
         # slow pair can't nominate each other)
         self.straggler_ratio = float(straggler_ratio)
+        # the goodput autopilot (observe→act over the fleet gauges this
+        # tick aggregates): pass an instance, or set DL4J_AUTOPILOT=1 for
+        # the default policy with the trainer's own evict path wired in
+        if autopilot is None:
+            from deeplearning4j_tpu.resilience.autopilot import (
+                GoodputAutopilot, autopilot_enabled)
+
+            if autopilot_enabled():
+                # silence threshold = the eviction timeout (one policy,
+                # two detectors); 120 s is MasterActor parity when the
+                # trainer runs without timeout-based eviction
+                autopilot = GoodputAutopilot(
+                    silence_s=(eviction_timeout_s
+                               if eviction_timeout_s is not None
+                               else 120.0))
+        self.autopilot = autopilot
+        if autopilot is not None:
+            autopilot.bind(
+                evict=lambda w, d: self.evict_worker(w, decision=d),
+                readmit=lambda w, d: self.readmitted.append(w))
         self.performers: List[WorkerPerformer] = []
         self.errors: List[str] = []
         self.evicted: List[str] = []
+        self.readmitted: List[str] = []
         self.eviction_log: List[dict] = []  # decisions + their evidence
         self.stragglers: set = set()
         self.monitors: Dict[str, Any] = {}
+        # per-worker stop events: a TARGETED eviction (autopilot
+        # straggler decision) must stop that worker's loop and beats —
+        # otherwise the evicted worker re-registers on its next beat,
+        # re-claims its own requeued job, and the fleet flaps
+        # evict/readmit forever while the straggler keeps dragging
+        self._worker_stops: Dict[str, threading.Event] = {}
         self._stats_lock = threading.Lock()
         self._worker_stats: Dict[str, Dict[str, Any]] = {}
         self._last_fleet_tick = 0.0
 
     def _worker_loop(self, worker_id: str, performer: WorkerPerformer,
-                     stop: threading.Event) -> None:
+                     stop: threading.Event,
+                     worker_stop: Optional[threading.Event] = None
+                     ) -> None:
         from deeplearning4j_tpu.parallel.cluster import HeartbeatMonitor
 
         # beats come from a background monitor thread, NOT the work loop:
@@ -256,13 +289,16 @@ class DistributedTrainer:
             payload_fn=lambda: self._heartbeat_payload(worker_id)).start()
         self.monitors[worker_id] = monitor
         try:
-            self._worker_poll(worker_id, performer, stop)
+            self._worker_poll(worker_id, performer, stop, worker_stop)
         finally:
             monitor.stop()
 
     def _worker_poll(self, worker_id: str, performer: WorkerPerformer,
-                     stop: threading.Event) -> None:
-        while not stop.is_set():
+                     stop: threading.Event,
+                     worker_stop: Optional[threading.Event] = None
+                     ) -> None:
+        while not stop.is_set() and not (worker_stop is not None
+                                         and worker_stop.is_set()):
             job = self.tracker.claim_job(worker_id)
             if job is None:
                 time.sleep(self.poll_s)
@@ -362,6 +398,62 @@ class DistributedTrainer:
                   ).set(float(len(self.stragglers)))
         return fleet
 
+    def evict_worker(self, worker_id: str, *, decision=None,
+                     reason: str = "autopilot") -> dict:
+        """Targeted eviction through the SAME evidence-logged path the
+        master tick's stale sweep uses: evidence gathered (beat age +
+        last payload), jobs requeued via the tracker, the decision
+        appended to ``eviction_log``, counter bumped, ``fleet.evict``
+        event on the timeline. The autopilot's evict actuator lands
+        here, so an autopilot-directed eviction is indistinguishable in
+        the audit trail from a timeout one — except for its recorded
+        reason."""
+        now = time.time()
+        t = self.tracker.last_heartbeat(worker_id)
+        evidence = {
+            "worker": worker_id,
+            "reason": (reason if decision is None
+                       else f"autopilot:{decision.reason}"),
+            "silent_s": None if t is None else round(now - t, 3),
+            "timeout_s": self.eviction_timeout_s,
+            "t_wall": now,
+            "last_metrics": self.tracker.heartbeat_metrics(worker_id),
+        }
+        # stop the worker FOR REAL (loop + beats), not just its tracker
+        # record: a still-running straggler would re-register on its next
+        # beat and re-claim its own requeued job — evict/readmit flap
+        wstop = self._worker_stops.get(worker_id)
+        if wstop is not None:
+            wstop.set()
+        monitor = self.monitors.get(worker_id)
+        if monitor is not None:
+            monitor.stop()
+        self.tracker.evict_worker(worker_id)
+        self.evicted.append(worker_id)
+        self.stragglers.discard(worker_id)
+        self.eviction_log.append(evidence)
+        record_counter("fleet_evictions_total", worker=worker_id)
+        tracer().event("fleet.evict", **{
+            k: v for k, v in evidence.items()
+            if isinstance(v, (str, int, float, bool))})
+        return evidence
+
+    def autopilot_tick(self, fleet: Dict[str, dict]) -> None:
+        """Feed the autopilot exactly what this master tick already
+        holds: the payload map, the straggler set, and the last-beat
+        timestamps. Decisions act through the bound actuators (evict →
+        :meth:`evict_worker`); the observe pass itself must never take
+        the training loop down."""
+        if self.autopilot is None:
+            return
+        try:
+            self.autopilot.observe(
+                fleet, stragglers=set(self.stragglers),
+                last_beat={w: self.tracker.last_heartbeat(w)
+                           for w in self.tracker.workers()})
+        except Exception:  # noqa: BLE001 — act layer is best-effort
+            logger.exception("autopilot observe pass failed")
+
     def _evict_tick(self) -> List[str]:
         """Evict stale workers AND record each decision with the
         evidence that justified it — beat age vs timeout plus the last
@@ -399,10 +491,13 @@ class DistributedTrainer:
         stop = threading.Event()
         self.performers = [self.performer_factory()
                            for _ in range(self.num_workers)]
+        self._worker_stops = {f"worker-{i}": threading.Event()
+                              for i in range(self.num_workers)}
         threads = [
             threading.Thread(
                 target=self._worker_loop,
-                args=(f"worker-{i}", p, stop), daemon=True)
+                args=(f"worker-{i}", p, stop,
+                      self._worker_stops[f"worker-{i}"]), daemon=True)
             for i, p in enumerate(self.performers)
         ]
         for t in threads:
@@ -418,7 +513,7 @@ class DistributedTrainer:
                 if now_mono - self._last_fleet_tick >= max(
                         self.poll_s, self.heartbeat_interval_s):
                     self._last_fleet_tick = now_mono
-                    self.fleet_tick()
+                    self.autopilot_tick(self.fleet_tick())
                 if self.eviction_timeout_s is not None:
                     stale = self._evict_tick()
                     if stale:

@@ -245,6 +245,31 @@ class DeviceDataSetCache:
         self.mesh = mesh                  # None = single-device placement
         self.n_shard = n_shard            # data-axis shards holding the stacks
 
+    def respec(self, mesh) -> "DeviceDataSetCache":
+        """Re-place the resident stacks for a DIFFERENT mesh in-process
+        (the elastic mid-run reshard path): each stack gathers to host
+        once and re-places with the batch axis sharded over the new
+        ``data`` axis when it tiles (replicated otherwise — placement is
+        an optimization, never a semantics change). The stacks' values
+        are untouched, so a fused chunk launched after ``respec`` reads
+        bit-identical data at the new width."""
+        n_shard = _data_shards(mesh)
+        sharded = mesh is not None and self.batch % n_shard == 0
+        if not sharded:
+            n_shard = 1
+
+        def move(a):
+            return None if a is None else _place(np.asarray(a), mesh,
+                                                 sharded)
+
+        self.features = move(self.features)
+        self.labels = move(self.labels)
+        self.features_mask = move(self.features_mask)
+        self.labels_mask = move(self.labels_mask)
+        self.mesh = mesh
+        self.n_shard = n_shard
+        return self
+
     @classmethod
     def build(cls, data, budget_mb: Optional[float] = None,
               buckets: Optional[Sequence[int]] = None, mesh=None,
@@ -357,6 +382,25 @@ class DeviceMultiDataSetCache:
         self.nbytes = nbytes
         self.mesh = mesh
         self.n_shard = n_shard
+
+    def respec(self, mesh) -> "DeviceMultiDataSetCache":
+        """Per-position twin of :meth:`DeviceDataSetCache.respec`."""
+        n_shard = _data_shards(mesh)
+        sharded = mesh is not None and self.batch % n_shard == 0
+        if not sharded:
+            n_shard = 1
+
+        def move_tuple(t):
+            return None if t is None else tuple(
+                _place(np.asarray(a), mesh, sharded) for a in t)
+
+        self.features = move_tuple(self.features)
+        self.labels = move_tuple(self.labels)
+        self.features_masks = move_tuple(self.features_masks)
+        self.labels_masks = move_tuple(self.labels_masks)
+        self.mesh = mesh
+        self.n_shard = n_shard
+        return self
 
     @classmethod
     def build(cls, data, budget_mb: Optional[float] = None,
@@ -475,27 +519,68 @@ def _traced_build(cls, data, budget_mb, buckets, mesh, accum_steps):
     return out
 
 
-def chunk_deadline_s(chunk_steps: int) -> float:
+def chunk_deadline_s(chunk_steps: int, width_factor: float = 1.0) -> float:
     """StepWatchdog deadline for one fused chunk dispatch, scaled by the
     number of fused optimizer steps it contains. ``DL4J_STEP_DEADLINE_S``
     sets the per-step budget exactly (tests use tiny values); unset, a
     generous 30 s/step floored at 120 s — the first dispatch includes the
     chunk program's XLA compile, which under remote compile can take
-    minutes on its own."""
+    minutes on its own.
+
+    ``width_factor`` rescales the budget after an elastic reshard: a
+    chunk on a mesh shrunk to ``1/f`` of the width the run started at
+    legitimately takes up to ``f``× longer per step, and must not be
+    flagged as a stall for it. Growth never tightens the deadline
+    (``width_factor`` is clamped to >= 1) — a generous deadline is a
+    missed detection at worst; a tight one aborts healthy work."""
     raw = os.environ.get("DL4J_STEP_DEADLINE_S", "")
     steps = max(1, int(chunk_steps))
+    factor = max(1.0, float(width_factor))
     try:
         if raw:
-            return float(raw) * steps
+            return float(raw) * steps * factor
     except ValueError:
         pass
-    return max(120.0, 30.0 * steps)
+    return max(120.0, 30.0 * steps * factor)
+
+
+def elastic_reshard(net, cache, mesh) -> None:
+    """Chunk-boundary mid-run mesh grow/shrink, in-process.
+
+    The hot-path twin of ``FaultTolerantTrainer.resume(mesh=)``'s
+    re-sharding contract, minus the checkpoint round trip: the trainable
+    state (params / updater state / net state) snapshots to FULL host
+    tensors (GSPMD's sharding is a layout, not a format — a full tensor
+    lands on any topology), re-places replicated on the new mesh, and
+    the dataset cache ``respec``s its stacks onto the new ``data`` axis.
+    Everything else — the epoch RNG key chain, the iteration count, the
+    LR scale, the chunk cursor — is host state the driver carries and is
+    untouched, so the continued run consumes the identical key stream
+    and visits the identical batches: final params match the
+    uninterrupted run to <= 1e-6 (the gradient all-reduce's summation
+    order is the only difference across widths).
+
+    ``mesh=None`` re-places on the default single device (shrink to one
+    chip)."""
+    import jax
+
+    params = jax.device_get(net.params)
+    upd = jax.device_get(net.updater_state)
+    nst = jax.device_get(net.net_state)
+    if mesh is None:
+        net.params = jax.device_put(params)
+        net.updater_state = jax.device_put(upd)
+        net.net_state = jax.device_put(nst)
+    else:
+        net.params, net.updater_state, net.net_state = params, upd, nst
+        net._place_replicated(mesh)
+    cache.respec(mesh)
 
 
 def drive_epoch_chunks(net, cache, num_epochs: int,
                        chunk_epochs: Optional[int], launch_chunk, *,
                        shuffle: bool = True, guard: str = "off",
-                       replay_step=None, on_chunk=None):
+                       replay_step=None, on_chunk=None, reshard=None):
     """The shared host-side chunk driver behind both classes' fit_epochs:
     splits the net's RNG into per-chunk epoch keys, launches each fused
     chunk (``launch_chunk(epoch_keys) -> ([k, N] hist, [k, N] trips or
@@ -542,7 +627,16 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
       returning True stops the run at this chunk boundary (the
       preemption-safe checkpoint hook — ``FaultTolerantTrainer`` sets
       the absolute epoch cursor, saves, and polls its
-      ``PreemptionGuard`` here).
+      ``PreemptionGuard`` here);
+    - elastic reshard: a pending ``net.request_reshard(mesh)`` request
+      is honored at the NEXT chunk boundary via the ``reshard(mesh)``
+      callback (both network classes pass ``elastic_reshard``): device
+      snapshot → respec → continue inside a ``reshard.elastic`` span
+      (the ledger books it as ``reshard`` badput), with the watchdog
+      deadline recomputed from the new chunk shape/device width. Fit
+      paths that pin per-mesh programs (``ParallelWrapper``) pass no
+      callback; a request there is logged and dropped, never applied
+      unsafely.
     """
     import jax
     import jax.numpy as jnp
@@ -579,6 +673,10 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     done = 0
     stopped = False
     run_error = None
+    # the width the deadline budget is calibrated at: a later shrink to
+    # 1/f of it rescales the watchdog deadline by f (satellite contract:
+    # a legitimate post-shrink chunk is slower, not stalled)
+    base_shard = max(1, cache.n_shard)
     watchdog = StepWatchdog(
         chunk_deadline_s(chunk_epochs * cache.n_batches))
     net._chunk_watchdog = watchdog  # introspection (tests, metrics)
@@ -592,6 +690,26 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
     try:
         with watchdog:
             while done < num_epochs:
+                pending = getattr(net, "_pending_mesh", None)
+                if pending is not None:
+                    net._pending_mesh = None
+                    new_mesh = pending[0]
+                    if reshard is None:
+                        logging.getLogger(__name__).warning(
+                            "elastic reshard requested but this fit "
+                            "path pins per-mesh programs; request "
+                            "dropped (use the plain fit_epochs path)")
+                    else:
+                        with tracer().span("reshard.elastic",
+                                           model=model_name,
+                                           epoch0=done) as rs:
+                            reshard(new_mesh)
+                            rs.attrs["n_shard"] = cache.n_shard
+                        record_counter("elastic_reshards_total",
+                                       model=model_name)
+                        watchdog.set_deadline(chunk_deadline_s(
+                            chunk_epochs * cache.n_batches,
+                            base_shard / max(1, cache.n_shard)))
                 k = min(chunk_epochs, num_epochs - done)
                 faults.fault_point("epoch.chunk")
                 keys = jax.random.split(net._rng, k + 1)

@@ -21,6 +21,15 @@ package instead of hand-rolling sleeps and bare ``except`` clauses:
 - :mod:`~deeplearning4j_tpu.resilience.preemption` — ``PreemptionGuard``
   latches SIGTERM / injected ``preempt.chunk`` faults so fused training
   checkpoints and stops at a chunk boundary instead of dying mid-run.
+- :mod:`~deeplearning4j_tpu.resilience.lease` — ``GrantLease`` bounded
+  watchdog around every backend acquisition (bench probe, dryrun child,
+  serve replica warm-up): a wedged grant releases and re-acquires under
+  escalating backoff instead of recording an error line and dying.
+- :mod:`~deeplearning4j_tpu.resilience.autopilot` —
+  ``GoodputAutopilot`` closes the observe→act loop over the PR-9 fleet
+  gauges: goodput below floor / straggler flagged / heartbeat silence
+  become evict/reshard/re-admit decisions, each evidence-logged as an
+  ``autopilot.decision`` event.
 
 Checkpoint integrity verification lives with its writer
 (``parallel.cluster.FaultTolerantTrainer``): sha256 manifest sidecars on
@@ -43,10 +52,22 @@ from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
     parse_spec,
     uninstall,
 )
+from deeplearning4j_tpu.resilience.autopilot import (  # noqa: F401
+    AutopilotDecision,
+    GoodputAutopilot,
+    autopilot_enabled,
+    goodput_floor,
+)
 from deeplearning4j_tpu.resilience.guard import (  # noqa: F401
     TrainingDivergedError,
     nan_guard_policy,
     tree_all_finite,
+)
+from deeplearning4j_tpu.resilience.lease import (  # noqa: F401
+    GrantLease,
+    GrantWedgedError,
+    grant_lease_s,
+    grant_reacquires,
 )
 from deeplearning4j_tpu.resilience.preemption import (  # noqa: F401
     PreemptionGuard,

@@ -23,7 +23,7 @@ from typing import Callable, Optional, Tuple, Type, Union
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["RetryPolicy", "RetryError", "no_jitter"]
+__all__ = ["RetryPolicy", "RetryError", "is_retryable", "no_jitter"]
 
 RetryableSpec = Union[Tuple[Type[BaseException], ...],
                       Callable[[BaseException], bool]]
@@ -43,6 +43,17 @@ def no_jitter(lo: float, hi: float) -> float:
     """Deterministic 'jitter' pinning each delay to its cap — use in tests
     that want the raw exponential sequence."""
     return hi
+
+
+def is_retryable(exc: BaseException, spec: RetryableSpec) -> bool:
+    """Shared retryable test (RetryPolicy AND GrantLease): a bare
+    exception class/tuple is a membership test, NOT a predicate —
+    treating it as one would call OSError(exc) (always truthy) and retry
+    everything, Ctrl-C included."""
+    if isinstance(spec, tuple) or (isinstance(spec, type)
+                                   and issubclass(spec, BaseException)):
+        return isinstance(exc, spec)
+    return bool(spec(exc))
 
 
 @dataclass
@@ -90,14 +101,7 @@ class RetryPolicy:
 
     # ------------------------------------------------------------------
     def _is_retryable(self, exc: BaseException) -> bool:
-        r = self.retryable
-        # a bare exception class is a membership test, NOT a predicate —
-        # treating it as one would call OSError(exc) (always truthy) and
-        # retry everything, Ctrl-C included
-        if isinstance(r, tuple) or (isinstance(r, type)
-                                    and issubclass(r, BaseException)):
-            return isinstance(exc, r)
-        return bool(r(exc))
+        return is_retryable(exc, self.retryable)
 
     def delay_for(self, attempt: int) -> float:
         """Backoff delay after failed attempt ``attempt`` (1-based)."""

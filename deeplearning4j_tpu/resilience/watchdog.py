@@ -69,6 +69,17 @@ class StepWatchdog:
         with self._lock:
             return self._clock() - self._last_beat
 
+    def set_deadline(self, deadline_s: float) -> None:
+        """Rescale the stall deadline mid-run (and re-arm the trigger).
+        The chunk driver calls this after an elastic reshard changes the
+        per-chunk step count / device width — a legitimate post-shrink
+        chunk must not be flagged against the old, wider mesh's budget."""
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        with self._lock:
+            self.deadline_s = deadline_s
+            self._last_beat = self._clock()
+
     # ------------------------------------------------------------------
     def start(self) -> "StepWatchdog":
         if self._thread is not None:

@@ -56,7 +56,7 @@ class FleetController:
     def __init__(self, router: FleetRouter, tracker=None, *,
                  evict_timeout_s: Optional[float] = None,
                  straggler_ratio: float = 3.0,
-                 clock=time.time):
+                 clock=time.time, autopilot=None):
         self.router = router
         self.tracker = tracker
         self.evict_timeout_s = (evict_timeout_s
@@ -64,6 +64,26 @@ class FleetController:
                                 else serve_evict_s())
         self.straggler_ratio = float(straggler_ratio)
         self.clock = clock
+        # the goodput autopilot rides the controller tick the same way
+        # it rides the training master's: DL4J_AUTOPILOT=1 builds the
+        # default policy, autopilot= passes an explicit one. Its evict
+        # actuator is the controller's own evidence-logged evict — the
+        # audit trail shows one eviction path regardless of who decided.
+        if autopilot is None:
+            from deeplearning4j_tpu.resilience.autopilot import (
+                GoodputAutopilot, autopilot_enabled)
+
+            if autopilot_enabled():
+                autopilot = GoodputAutopilot(
+                    silence_s=self.evict_timeout_s, clock=clock)
+        self.autopilot = autopilot
+        if autopilot is not None:
+            autopilot.bind(evict=lambda rid, d: self.evict(
+                rid, reason=f"autopilot:{d.reason}",
+                silent_s=d.gauges.get("silent_s"),
+                last_metrics={k: v for k, v in d.gauges.items()
+                              if k not in ("silent_s",
+                                           "silence_timeout_s")}))
         self.stragglers: set = set()
         self.evicted: List[str] = []
         self.eviction_log: List[dict] = []
@@ -104,6 +124,22 @@ class FleetController:
             counter_name="fleet_serve_stragglers_total",
             event_name="serve.straggler")
         self._evict_pass(now, fleet)
+        if self.autopilot is not None:
+            try:
+                live = [r.replica_id for r in self.router.replicas
+                        if r.replica_id not in self._evicted_set]
+                self.autopilot.observe(
+                    fleet, stragglers=set(self.stragglers),
+                    last_beat=(
+                        {rid: self.tracker.last_heartbeat(rid)
+                         for rid in live}
+                        if self.tracker is not None else None),
+                    now=now)
+            except Exception:  # noqa: BLE001 — observe-only must not
+                import logging  # take the serve control loop down
+
+                logging.getLogger(__name__).exception(
+                    "serve autopilot observe pass failed")
         alive = [r for r in self.router.replicas
                  if r.replica_id not in self._evicted_set and r.alive]
         self._reg.gauge("fleet_serve_replicas",
@@ -143,7 +179,11 @@ class FleetController:
               last_metrics: Optional[dict] = None) -> dict:
         """Evict one replica: evidence-logged decision, gauges dropped,
         in-flight requests failed over. Also the bench/dryrun's forced-
-        eviction hook."""
+        eviction hook. Idempotent: the silence sweep and an
+        autopilot-directed eviction may both reach the same corpse —
+        only the first one acts."""
+        if replica_id in self._evicted_set:
+            return {"replica": replica_id, "reason": "already_evicted"}
         replica = self.router._by_id[replica_id]
         # kill, don't just flag: a silence-evicted replica may still be
         # RUNNING (stalled beats, live loop) — leaving its loop up would

@@ -56,7 +56,7 @@ class ServeReplica:
                  heartbeat_interval_s: float = 1.0,
                  poll_s: float = 0.002,
                  clock=time.monotonic, server: Optional[DecodeServer] = None,
-                 **server_kw):
+                 lease=None, **server_kw):
         self.replica_id = str(replica_id)
         self.role = role if role is not None else serve_role()
         if self.role not in SERVE_ROLES:
@@ -68,6 +68,13 @@ class ServeReplica:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.poll_s = poll_s
         self.clock = clock
+        # grant lease around this replica's backend acquisition (program
+        # warm-up / device claim): a wedged acquisition re-acquires under
+        # the lease's bounded watchdog instead of hanging the replica
+        # thread; exhaustion marks the replica dead so the controller
+        # evicts it and fails its requests over — the fleet loses one
+        # member, never the run. None = acquire-free start (default).
+        self.lease = lease
         self.monitor = None
         self.dead = False
         self.dead_reason: Optional[str] = None
@@ -241,6 +248,19 @@ class ServeReplica:
     def start(self) -> "ServeReplica":
         if self._thread is not None and self._thread.is_alive():
             return self
+        if self.lease is not None:
+            from deeplearning4j_tpu.resilience.lease import (
+                GrantWedgedError)
+
+            try:
+                self.lease.acquire()
+            except GrantWedgedError as e:
+                # a replica that never got its grant is a dead replica:
+                # the controller's crash path evicts it with the lease's
+                # evidence and fails its (zero) requests over — the
+                # fleet shrinks by one instead of wedging on it
+                self._die(f"grant wedged: {e}")
+                return self
         if self.tracker is not None and self.monitor is None:
             from deeplearning4j_tpu.parallel.cluster import HeartbeatMonitor
 

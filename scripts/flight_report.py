@@ -18,6 +18,11 @@ state:
   BENCH_r04/r05 grant-wedge shape
 - ``crashed``   — records stop abruptly (the heartbeats died with the
   progress): SIGKILL, OOM, segfault
+- ``reacquired`` — clean-with-recovery: the run finished, but the
+  timeline carries ``grant.reacquired`` evidence — a wedged grant was
+  rescued by the lease protocol (resilience/lease.py) instead of
+  costing the round. Counts as a healthy ending operationally, but is
+  reported distinctly so chronic grant flapping stays visible
 
 Usage:
     python scripts/flight_report.py <flight-dir>            # human report
@@ -103,6 +108,9 @@ def print_report(report: dict, out=None) -> None:
         print(f"silence    : {ev['silent_s']}s past last progress "
               f"(heartbeat every {ev.get('heartbeat_interval_s')}s)",
               file=out)
+    if ev.get("n_reacquires"):
+        print(f"reacquires : {ev['n_reacquires']} wedged grant(s) "
+              "rescued by the lease protocol", file=out)
     print(f"records    : {report['n_records']} surviving "
           f"({report['n_runs_started']} run(s) started, "
           f"{report['n_chunks_done']} chunk(s) completed)", file=out)

@@ -55,6 +55,15 @@
 #                                      over the committed BENCH_r*.json
 #                                      trajectory; nonzero exit on a
 #                                      bench regression)
+#        scripts/verify.sh --autopilot (always-on fleet: the grant-lease
+#                                      protocol, elastic mid-run reshard
+#                                      equivalence, goodput-autopilot
+#                                      decision suite, and the bounded
+#                                      chaos soak (preempt + wedge +
+#                                      straggle + evict, 1e-6 final-state
+#                                      + goodput-floor asserts) — plus
+#                                      the host-sync and lock-discipline
+#                                      lint over the resilience modules)
 #        scripts/verify.sh --mfu      (mixed-precision MFU push: the
 #                                      mixed_bf16 master-weights suite —
 #                                      fused-epoch loss parity vs f32,
@@ -128,6 +137,16 @@ elif [ "${1:-}" = "--profile" ]; then
     # must show no silent round-over-round regression (wedge/error
     # rounds are called out but never scored)
     python scripts/bench_report.py --check BENCH_r*.json || exit 1
+elif [ "${1:-}" = "--autopilot" ]; then
+    shift
+    TARGET=tests/test_autopilot.py
+    # the always-on layer's control plane is host-side by construction:
+    # the lease/autopilot/reshard code must introduce no host syncs into
+    # traced programs and no unlocked cross-thread state (the lease's
+    # daemon-thread attempt + the autopilot's tick both ride threads)
+    python scripts/dl4j_lint.py \
+        --select host-sync-in-hot-path,lock-discipline \
+        deeplearning4j_tpu/resilience deeplearning4j_tpu/perf || exit 1
 elif [ "${1:-}" = "--mfu" ]; then
     shift
     TARGET=tests/test_mixed_precision.py

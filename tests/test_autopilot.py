@@ -1,0 +1,931 @@
+"""Always-on fleet: grant lease protocol, elastic mesh autopilot, chaos soak.
+
+The contract under test (resilience/lease.py + resilience/autopilot.py +
+the elastic reshard path through drive_epoch_chunks + the flight
+recorder's ``reacquired`` end state):
+
+- a :class:`GrantLease` bounds every acquisition attempt, releases and
+  RE-ACQUIRES under escalating backoff on a wedge (``grant.reacquire``
+  spans, ``grant.backoff`` booked as ``grant_wait`` badput), raises
+  ``GrantWedgedError`` only on exhaustion, and leaves a
+  ``grant.reacquired`` rescue record that flight classification reports
+  as ``reacquired`` (clean-with-recovery) instead of wedged;
+- ``net.request_reshard(mesh)`` applies at the NEXT chunk boundary via
+  the in-process elastic reshard (device snapshot → respec → continue):
+  final params within 1e-6 of the uninterrupted run, cursor/RNG/updater
+  state carried exactly, the StepWatchdog deadline recomputed from the
+  new chunk shape/device width;
+- :class:`GoodputAutopilot` turns the PR-9 fleet gauges into
+  evict/reshard/re-admit decisions — silence past the threshold and
+  straggler STREAKS evict, goodput below ``DL4J_GOODPUT_FLOOR``
+  reshards, a healthy returning member re-admits — every decision
+  evidence-logged as an ``autopilot.decision`` event and routed through
+  the same evidence paths the master tick uses
+  (``DistributedTrainer.evict_worker`` → ``eviction_log``);
+- the chaos soak: a bounded preempt + wedge + straggle + evict schedule
+  across a multi-chunk fused run finishes with final params within 1e-6
+  of the unfaulted run, the wedge re-acquired and booked as
+  ``grant_wait``, and ledger goodput above the configured floor (the
+  full-length soak rides the ``slow``+``chaos`` markers).
+"""
+
+import importlib.util
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.monitor import metrics, tracer
+from deeplearning4j_tpu.monitor.flight import (
+    FlightRecorder,
+    classify_end_state,
+    set_flight,
+)
+from deeplearning4j_tpu.monitor.ledger import (
+    RunLedger,
+    run_ledger,
+    set_run_ledger,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import ParallelWrapper, build_mesh
+from deeplearning4j_tpu.parallel.cluster import FaultTolerantTrainer
+from deeplearning4j_tpu.parallel.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.parallel.workrouter import DistributedTrainer
+from deeplearning4j_tpu.perf.epoch_cache import (
+    DeviceDataSetCache,
+    chunk_deadline_s,
+)
+from deeplearning4j_tpu.resilience import (
+    AutopilotDecision,
+    GoodputAutopilot,
+    GrantLease,
+    GrantWedgedError,
+    autopilot_enabled,
+    fail_nth,
+    fail_times,
+    goodput_floor,
+    inject,
+)
+from deeplearning4j_tpu.resilience.lease import (
+    grant_lease_s,
+    grant_reacquires,
+)
+
+TOL = dict(rtol=0, atol=1e-6)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+flight_report = _load_script("flight_report")
+
+
+def _ff_net(seed=7):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+        .updater(Updater.ADAM).list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph_net(seed=7):
+    g = (
+        NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+        .updater(Updater.ADAM)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("dense", L.DenseLayer(n_in=6, n_out=8,
+                                         activation="tanh"), "in")
+        .add_layer("out", L.OutputLayer(n_in=8, n_out=3), "dense")
+        .set_outputs("out")
+    )
+    return ComputationGraph(g.build()).init()
+
+
+def _ff_data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _assert_close(a, b, **tol):
+    la = jax.tree_util.tree_leaves(jax.device_get(a))
+    lb = jax.tree_util.tree_leaves(jax.device_get(b))
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x, np.float64),
+                                   np.asarray(y, np.float64),
+                                   **(tol or TOL))
+
+
+def _new_spans(mark):
+    return tracer().spans()[mark:]
+
+
+# ---------------------------------------------------------------------------
+# grant lease protocol
+# ---------------------------------------------------------------------------
+
+
+class TestGrantLease:
+    def test_clean_first_attempt(self):
+        lease = GrantLease("t", lambda: "ok", bounded=False,
+                           sleep=lambda s: None)
+        mark = len(tracer().spans())
+        assert lease.acquire() == "ok"
+        assert lease.state == "held"
+        assert lease.reacquires == 0
+        names = [s.name for s in _new_spans(mark)]
+        assert "grant.acquire" in names
+        assert "grant.reacquired" not in names
+        assert "grant.reacquire" not in names
+
+    def test_transient_failure_reacquires_with_escalating_backoff(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def acq():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise OSError("wedged")
+            return "grant"
+
+        lease = GrantLease("t", acq, bounded=False, max_reacquires=3,
+                           base_backoff_s=2.0, backoff_multiplier=2.0,
+                           sleep=sleeps.append)
+        mark = len(tracer().spans())
+        assert lease.acquire() == "grant"
+        assert lease.reacquires == 2
+        # deterministic escalation: 2, then 4 (capped at max_backoff_s)
+        assert sleeps == [2.0, 4.0]
+        names = [s.name for s in _new_spans(mark)]
+        assert names.count("grant.reacquire") == 2
+        assert "grant.reacquired" in names
+        assert "grant.backoff" in names
+
+    def test_bounded_wedge_detected_without_exception(self):
+        """A blocking acquisition that never raises is still detected:
+        the daemon-thread bound turns silence into a wedge."""
+        release_calls = []
+        gate = threading.Event()
+        calls = {"n": 0}
+
+        def acq():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                gate.wait()  # wedge: blocks until the test releases it
+            return "late-grant"
+
+        lease = GrantLease("t", acq, bounded=True, lease_s=0.05,
+                           max_reacquires=1,
+                           release=lambda: release_calls.append(1),
+                           sleep=lambda s: None)
+        try:
+            assert lease.acquire() == "late-grant"
+            assert lease.reacquires == 1
+            assert release_calls == [1]  # released before re-acquiring
+        finally:
+            gate.set()  # never leak a blocked thread
+
+    def test_exhaustion_raises_grant_wedged(self):
+        gate = threading.Event()
+        lease = GrantLease("t", gate.wait, bounded=True,
+                           lease_s=0.02, max_reacquires=1,
+                           sleep=lambda s: None)
+        try:
+            with pytest.raises(GrantWedgedError) as ei:
+                lease.acquire()
+        finally:
+            gate.set()
+        assert ei.value.attempts == 2
+        assert lease.state == "wedged"
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def acq():
+            calls["n"] += 1
+            raise ValueError("code bug, not a wedge")
+
+        lease = GrantLease("t", acq, bounded=False,
+                           retryable=(OSError,), sleep=lambda s: None)
+        with pytest.raises(ValueError):
+            lease.acquire()
+        assert calls["n"] == 1  # no backoff budget burned
+
+    def test_probe_gates_reacquire(self):
+        """A failing re-probe consumes the cycle WITHOUT paying the full
+        acquisition; a passing one proceeds to the real attempt."""
+        probes = []
+        acquires = {"n": 0}
+
+        def probe():
+            probes.append(1)
+            return len(probes) >= 2  # first re-probe fails
+
+        def acq():
+            acquires["n"] += 1
+            if acquires["n"] == 1:
+                raise OSError("wedged")
+            return "ok"
+
+        lease = GrantLease("t", acq, bounded=False, probe=probe,
+                           max_reacquires=3, sleep=lambda s: None)
+        assert lease.acquire() == "ok"
+        assert len(probes) == 2      # failed once, passed once
+        assert acquires["n"] == 2    # the failed probe skipped acquire
+
+    def test_fault_site_wedges_deterministically(self):
+        """DL4J_FAULTS-style chaos: an injected grant.lease fault is a
+        wedged attempt, re-acquired like a real one."""
+        with inject("grant.lease", fail_times(1)):
+            lease = GrantLease("t", lambda: "ok", bounded=False,
+                               max_reacquires=2, sleep=lambda s: None)
+            assert lease.acquire() == "ok"
+        assert lease.reacquires == 1
+
+    def test_fault_site_bypasses_narrow_retryable_filter(self):
+        """The documented chaos contract holds on EVERY lease, including
+        ones (bench probe/init, dryrun child) whose retryable filters
+        name only their real failure types: an injected FaultInjected is
+        always a wedge, never a crash."""
+        class _OnlyThis(RuntimeError):
+            pass
+
+        with inject("grant.lease", fail_times(1)):
+            lease = GrantLease("t", lambda: "ok", bounded=False,
+                               max_reacquires=2, retryable=(_OnlyThis,),
+                               sleep=lambda s: None)
+            assert lease.acquire() == "ok"
+        assert lease.reacquires == 1
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("DL4J_GRANT_LEASE_S", "17.5")
+        monkeypatch.setenv("DL4J_GRANT_REACQUIRES", "4")
+        assert grant_lease_s() == 17.5
+        assert grant_reacquires() == 4
+        monkeypatch.setenv("DL4J_GRANT_LEASE_S", "bogus")
+        monkeypatch.setenv("DL4J_GRANT_REACQUIRES", "bogus")
+        assert grant_lease_s() == 90.0
+        assert grant_reacquires() == 2
+        lease = GrantLease("t", lambda: 1)
+        assert lease.lease_s == 90.0
+        assert lease.max_reacquires == 2
+
+    def test_ledger_books_reacquire_as_grant_wait(self):
+        """The rescue costs ledger-booked grant_wait badput, not the
+        round: backoff + reacquire spans classify under grant_wait."""
+        ledger = RunLedger(span_source=lambda: tracer().spans())
+        calls = {"n": 0}
+
+        def acq():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("wedged")
+            return "ok"
+
+        lease = GrantLease("t", acq, bounded=False, max_reacquires=1,
+                           base_backoff_s=0.05, sleep=time.sleep)
+        assert lease.acquire() == "ok"
+        rep = ledger.report()
+        assert rep["states"]["grant_wait"] >= 0.04
+
+
+# ---------------------------------------------------------------------------
+# flight classification: reacquired = clean-with-recovery
+# ---------------------------------------------------------------------------
+
+
+class TestFlightReacquired:
+    def _records(self, *, reacquired: bool, status="clean"):
+        recs = [{"kind": "run.start", "t_wall": 1.0}]
+        if reacquired:
+            recs.append({"kind": "span", "name": "grant.reacquired",
+                         "t_wall": 2.0, "attrs": {"attempts": 1}})
+        recs += [{"kind": "chunk.done", "t_wall": 3.0},
+                 {"kind": "run.end", "status": status, "t_wall": 4.0}]
+        return recs
+
+    def test_clean_with_rescue_classifies_reacquired(self):
+        v = classify_end_state(self._records(reacquired=True))
+        assert v["end_state"] == "reacquired"
+        assert v["evidence"]["n_reacquires"] == 1
+
+    def test_clean_without_rescue_stays_clean(self):
+        v = classify_end_state(self._records(reacquired=False))
+        assert v["end_state"] == "clean"
+
+    def test_error_status_beats_reacquired(self):
+        v = classify_end_state(
+            self._records(reacquired=True, status="error:RuntimeError"))
+        assert v["end_state"] == "crashed"
+
+    def test_unclosed_run_with_wedge_evidence_still_wedged(self):
+        recs = [{"kind": "run.start", "t_wall": 1.0},
+                {"kind": "span", "name": "grant.watchdog", "t_wall": 2.0}]
+        assert classify_end_state(recs)["end_state"] == "wedged"
+
+    def test_recorder_round_trip_and_report_tool(self, tmp_path):
+        """A real segment ring carrying a lease rescue classifies as
+        reacquired through scripts/flight_report.py too."""
+        fr = flight_report
+        d = str(tmp_path / "flight")
+        rec = FlightRecorder(d, heartbeat_s_=60.0)
+        set_flight(rec)
+        try:
+            from deeplearning4j_tpu.monitor.ledger import (
+                ledger_chunk_done, ledger_chunk_start, ledger_run_end,
+                ledger_run_start)
+
+            set_run_ledger(RunLedger())
+            ledger_run_start(model="t", epochs=1)
+            tracer().event("grant.reacquired", lease="t", attempts=2)
+            ledger_chunk_start()
+            ledger_chunk_done()
+            ledger_run_end(status="clean")
+        finally:
+            set_flight(None)
+            set_run_ledger(None)
+            rec.close()
+        report = fr.build_report(d)
+        assert report["end_state"] == "reacquired"
+        assert report["evidence"]["n_reacquires"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mid-run elastic reshard
+# ---------------------------------------------------------------------------
+
+
+def _reshard_schedule(net, plan):
+    """on_chunk callback issuing request_reshard per the {chunk: mesh}
+    plan (the autopilot's actuator path, driven deterministically)."""
+    seen = {"n": 0}
+
+    def on_chunk(done):
+        seen["n"] += 1
+        if seen["n"] in plan:
+            net.request_reshard(plan[seen["n"]])
+        return False
+
+    return on_chunk
+
+
+class TestElasticReshard:
+    def test_mln_grow_then_shrink_1e6(self):
+        data = [_ff_data(8, seed=i) for i in range(4)]
+        base = _ff_net()
+        hist_a = base.fit_epochs(ListDataSetIterator(list(data), 8), 6,
+                                 chunk_epochs=2)
+        net = _ff_net()
+        hist_b = net.fit_epochs(
+            ListDataSetIterator(list(data), 8), 6, chunk_epochs=2,
+            on_chunk=_reshard_schedule(
+                net, {1: build_mesh(), 2: None}))
+        _assert_close(base.params, net.params)
+        _assert_close(base.updater_state, net.updater_state)
+        np.testing.assert_allclose(np.asarray(hist_a),
+                                   np.asarray(hist_b), **TOL)
+        # cursor + RNG chain carried exactly through both reshards
+        assert base.iteration_count == net.iteration_count
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(base._rng)),
+            np.asarray(jax.device_get(net._rng)))
+
+    def test_graph_reshard_1e6(self):
+        data = [_ff_data(8, seed=i) for i in range(3)]
+        base = _graph_net()
+        base.fit_epochs(ListDataSetIterator(list(data), 8), 4,
+                        chunk_epochs=1)
+        net = _graph_net()
+        net.fit_epochs(
+            ListDataSetIterator(list(data), 8), 4, chunk_epochs=1,
+            on_chunk=_reshard_schedule(net, {2: build_mesh()}))
+        _assert_close(base.params, net.params)
+
+    def test_reshard_starting_from_mesh(self):
+        """A run LAUNCHED on the mesh shrinks to one device mid-run."""
+        data = [_ff_data(8, seed=i) for i in range(4)]
+        base = _ff_net()
+        base.fit_epochs(ListDataSetIterator(list(data), 8), 4,
+                        chunk_epochs=1)
+        net = _ff_net()
+        net.fit_epochs(
+            ListDataSetIterator(list(data), 8), 4, chunk_epochs=1,
+            mesh=build_mesh(),
+            on_chunk=_reshard_schedule(net, {2: None}))
+        _assert_close(base.params, net.params)
+
+    def test_respec_values_bitwise_and_shard_accounting(self):
+        cache = DeviceDataSetCache.build([_ff_data(16)])
+        ref = np.asarray(cache.features)
+        mesh = build_mesh()
+        cache.respec(mesh)
+        assert cache.mesh is mesh
+        assert cache.n_shard == 8  # batch 16 tiles the 8-way data axis
+        np.testing.assert_array_equal(np.asarray(cache.features), ref)
+        cache.respec(None)
+        assert cache.mesh is None and cache.n_shard == 1
+        np.testing.assert_array_equal(np.asarray(cache.features), ref)
+
+    def test_watchdog_deadline_rescaled_after_shrink(self, monkeypatch):
+        """Satellite contract: after an 8→1 shrink the chunk deadline
+        grows by the width factor — a legitimately slower chunk is not
+        flagged as a stall."""
+        monkeypatch.setenv("DL4J_STEP_DEADLINE_S", "10")
+        data = [_ff_data(8, seed=i) for i in range(2)]
+        net = _ff_net()
+        net.fit_epochs(
+            ListDataSetIterator(list(data), 8), 3, chunk_epochs=1,
+            mesh=build_mesh(),
+            on_chunk=_reshard_schedule(net, {1: None}))
+        # per-step 10 s x (1 epoch x 2 batches) x width factor 8
+        assert net._chunk_watchdog.deadline_s == pytest.approx(160.0)
+
+    def test_chunk_deadline_width_factor(self, monkeypatch):
+        monkeypatch.setenv("DL4J_STEP_DEADLINE_S", "2")
+        assert chunk_deadline_s(10) == pytest.approx(20.0)
+        assert chunk_deadline_s(10, width_factor=4) == pytest.approx(80.0)
+        # growth never TIGHTENS the deadline
+        assert chunk_deadline_s(10, width_factor=0.25) == pytest.approx(
+            20.0)
+        monkeypatch.delenv("DL4J_STEP_DEADLINE_S")
+        assert chunk_deadline_s(1, width_factor=8) == pytest.approx(240.0)
+
+    def test_wrapper_path_drops_request_with_warning(self, caplog):
+        """ParallelWrapper pins per-mesh programs: a reshard request on
+        its underlying net is logged and DROPPED, never applied
+        unsafely."""
+        data = [_ff_data(16, seed=i) for i in range(2)]
+        net = _ff_net()
+        wrapper = ParallelWrapper(net, mesh=build_mesh())
+        net.request_reshard(None)
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.perf.epoch_cache"):
+            wrapper.fit_epochs(ListDataSetIterator(list(data), 16), 2,
+                               chunk_epochs=1)
+        assert net._pending_mesh is None
+        assert any("reshard" in r.message for r in caplog.records)
+
+    def test_reshard_span_and_counter_on_timeline(self):
+        data = [_ff_data(8, seed=i) for i in range(2)]
+        net = _ff_net()
+        mark = len(tracer().spans())
+        net.fit_epochs(ListDataSetIterator(list(data), 8), 3,
+                       chunk_epochs=1,
+                       on_chunk=_reshard_schedule(net, {1: build_mesh()}))
+        spans = [s for s in _new_spans(mark) if s.name == "reshard.elastic"]
+        assert len(spans) == 1
+        assert spans[0].attrs["n_shard"] == 8
+
+
+# ---------------------------------------------------------------------------
+# goodput autopilot
+# ---------------------------------------------------------------------------
+
+
+class TestAutopilot:
+    def test_silence_evicts_with_evidence(self):
+        acted = []
+        ap = GoodputAutopilot(floor=0.0, silence_s=5.0,
+                              clock=lambda: 100.0,
+                              evict=lambda w, d: acted.append(w))
+        mark = len(tracer().spans())
+        out = ap.observe({"w0": {"step_s": 1.0}},
+                         last_beat={"w0": 99.0, "w1": 80.0})
+        assert [(d.action, d.target) for d in out] == [("evict", "w1")]
+        assert out[0].reason == "heartbeat_silence"
+        assert out[0].gauges["silent_s"] == pytest.approx(20.0)
+        assert out[0].acted
+        assert acted == ["w1"]
+        evs = [s for s in _new_spans(mark)
+               if s.name == "autopilot.decision"]
+        assert len(evs) == 1
+        assert evs[0].attrs["action"] == "evict"
+        assert evs[0].attrs["silent_s"] == pytest.approx(20.0)
+
+    def test_straggler_needs_a_streak(self):
+        acted = []
+        ap = GoodputAutopilot(floor=0.0, silence_s=1e9,
+                              straggler_ticks=2, clock=lambda: 0.0,
+                              evict=lambda w, d: acted.append(w))
+        fleet = {"w0": {"step_s": 9.0}}
+        assert ap.observe(fleet, stragglers=["w0"]) == []
+        out = ap.observe(fleet, stragglers=["w0"])
+        assert [(d.action, d.target, d.reason) for d in out] == [
+            ("evict", "w0", "straggler_streak")]
+        assert acted == ["w0"]
+
+    def test_straggler_streak_resets_on_recovery(self):
+        ap = GoodputAutopilot(floor=0.0, silence_s=1e9,
+                              straggler_ticks=2, clock=lambda: 0.0)
+        assert ap.observe({}, stragglers=["w0"]) == []
+        assert ap.observe({}, stragglers=[]) == []      # recovered
+        assert ap.observe({}, stragglers=["w0"]) == []  # streak restarted
+
+    def test_goodput_floor_reshard_and_cooldown(self):
+        resharded = []
+        t = {"now": 0.0}
+        ap = GoodputAutopilot(floor=50.0, silence_s=1e9, cooldown_s=10.0,
+                              clock=lambda: t["now"],
+                              reshard=lambda h, d: resharded.append(
+                                  tuple(h)))
+        fleet = {"w0": {"goodput_pct": 20.0}, "w1": {"goodput_pct": 90.0}}
+        out = ap.observe(fleet, stragglers=["w1"])
+        assert [d.action for d in out] == ["reshard"]
+        assert out[0].gauges["goodput_pct"] == 20.0  # fleet min
+        assert resharded == [("w0",)]  # straggler excluded from healthy
+        t["now"] = 5.0
+        assert ap.observe(fleet) == []  # cooling down
+        t["now"] = 11.0
+        assert [d.action for d in ap.observe(fleet)] == ["reshard"]
+
+    def test_readmit_after_healthy_return(self):
+        readmits = []
+        t = {"now": 100.0}
+        ap = GoodputAutopilot(floor=0.0, silence_s=5.0,
+                              clock=lambda: t["now"],
+                              readmit=lambda w, d: readmits.append(w))
+        ap.observe({}, last_beat={"w0": 10.0})  # silent -> evicted
+        assert ap.evicted == {"w0"}
+        t["now"] = 200.0
+        out = ap.observe({"w0": {"step_s": 1.0}},
+                         last_beat={"w0": 199.0})
+        assert [(d.action, d.target) for d in out] == [("readmit", "w0")]
+        assert ap.evicted == set()
+        assert readmits == ["w0"]
+
+    def test_actuator_failure_marks_not_acted(self):
+        def boom(w, d):
+            raise RuntimeError("actuator down")
+
+        ap = GoodputAutopilot(floor=0.0, silence_s=1.0,
+                              clock=lambda: 100.0, evict=boom)
+        out = ap.observe({}, last_beat={"w0": 0.0})
+        assert len(out) == 1 and not out[0].acted
+
+    def test_failed_eviction_not_latched_and_retried(self):
+        """A bound evict actuator that RAISES leaves the member
+        un-latched: the next tick retries instead of permanently
+        forgetting a still-wedged worker over one tracker hiccup."""
+        calls = {"n": 0}
+
+        def flaky(w, d):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("tracker hiccup")
+
+        ap = GoodputAutopilot(floor=0.0, silence_s=1.0,
+                              clock=lambda: 100.0, evict=flaky)
+        out1 = ap.observe({}, last_beat={"w0": 0.0})
+        assert not out1[0].acted and ap.evicted == set()
+        out2 = ap.observe({}, last_beat={"w0": 0.0})
+        assert out2[0].acted and ap.evicted == {"w0"}
+
+    def test_straggler_eviction_not_readmitted_same_pass(self):
+        """The beat snapshot that justified a straggler eviction still
+        carries that member's fresh beat — readmit must require a beat
+        NEWER than the eviction, or every straggler eviction would be
+        instantly contradicted."""
+        t = {"now": 100.0}
+        ap = GoodputAutopilot(floor=0.0, silence_s=1e9,
+                              straggler_ticks=1,
+                              clock=lambda: t["now"],
+                              evict=lambda w, d: None)
+        fleet = {"w0": {"step_s": 9.0}}
+        out = ap.observe(fleet, stragglers=["w0"],
+                         last_beat={"w0": 99.5})
+        assert [d.action for d in out] == ["evict"]
+        assert ap.evicted == {"w0"}
+        # same stale beat next tick: still no readmit
+        out = ap.observe(fleet, stragglers=[], last_beat={"w0": 99.5})
+        assert out == []
+        # a genuinely NEW beat after the eviction readmits
+        t["now"] = 110.0
+        out = ap.observe(fleet, stragglers=[], last_beat={"w0": 109.0})
+        assert [d.action for d in out] == ["readmit"]
+
+    def test_decision_counter_labeled_by_action(self):
+        ap = GoodputAutopilot(floor=0.0, silence_s=1.0,
+                              clock=lambda: 100.0)
+        before = metrics().counter("autopilot_decisions_total").value(
+            action="evict")
+        ap.observe({}, last_beat={"w0": 0.0})
+        assert metrics().counter("autopilot_decisions_total").value(
+            action="evict") == before + 1
+
+    def test_env_helpers(self, monkeypatch):
+        monkeypatch.setenv("DL4J_AUTOPILOT", "1")
+        assert autopilot_enabled()
+        monkeypatch.setenv("DL4J_AUTOPILOT", "off")
+        assert not autopilot_enabled()
+        monkeypatch.setenv("DL4J_GOODPUT_FLOOR", "72.5")
+        assert goodput_floor() == 72.5
+        monkeypatch.setenv("DL4J_GOODPUT_FLOOR", "junk")
+        assert goodput_floor() == 50.0
+
+
+class TestTrainerIntegration:
+    def _trainer(self, tracker, silence_s=0.05):
+        from deeplearning4j_tpu.parallel.workrouter import (
+            IterativeReduceWorkRouter)
+
+        ap = GoodputAutopilot(floor=0.0, silence_s=silence_s)
+        return DistributedTrainer(
+            tracker, IterativeReduceWorkRouter(tracker),
+            performer_factory=lambda: None, num_workers=1,
+            autopilot=ap)
+
+    def test_autopilot_evicts_through_evidence_logged_path(self):
+        tracker = InMemoryStateTracker()
+        trainer = self._trainer(tracker)
+        tracker.heartbeat("w0", metrics={"step_s": 2.5})
+        time.sleep(0.08)
+        trainer.autopilot_tick(trainer.fleet_tick())
+        assert trainer.evicted == ["w0"]
+        assert "w0" not in tracker.workers()
+        assert len(trainer.eviction_log) == 1
+        entry = trainer.eviction_log[0]
+        assert entry["reason"] == "autopilot:heartbeat_silence"
+        assert entry["silent_s"] is not None
+        assert entry["last_metrics"]["step_s"] == 2.5
+
+    def test_evict_worker_requeues_claimed_jobs(self):
+        tracker = InMemoryStateTracker()
+        trainer = self._trainer(tracker)
+        tracker.add_job({"x": 1})
+        tracker.heartbeat("w0")
+        job = tracker.claim_job("w0")
+        assert job is not None
+        trainer.evict_worker("w0", reason="test")
+        assert tracker.jobs(status="pending")
+        assert not tracker.jobs(status="claimed")
+
+    def test_evict_worker_stops_loop_and_monitor(self):
+        """A targeted eviction stops the worker FOR REAL — loop stop
+        event set, heartbeat monitor stopped — or the still-running
+        straggler would re-register on its next beat and the fleet
+        would flap evict/readmit forever."""
+        tracker = InMemoryStateTracker()
+        trainer = self._trainer(tracker)
+        wstop = threading.Event()
+        trainer._worker_stops["w0"] = wstop
+
+        class _Mon:
+            stopped = False
+
+            def stop(self):
+                self.stopped = True
+
+        mon = _Mon()
+        trainer.monitors["w0"] = mon
+        tracker.heartbeat("w0")
+        trainer.evict_worker("w0", reason="test")
+        assert wstop.is_set()
+        assert mon.stopped
+        assert "w0" not in tracker.workers()
+
+    def test_file_tracker_evict_worker(self, tmp_path):
+        from deeplearning4j_tpu.parallel.statetracker import (
+            FileStateTracker)
+
+        tracker = FileStateTracker(str(tmp_path))
+        tracker.add_job({"x": 1})
+        tracker.heartbeat("w0")
+        tracker.claim_job("w0")
+        assert tracker.evict_worker("w0")
+        assert "w0" not in tracker.workers()
+        assert tracker.jobs(status="pending")
+
+    def test_replica_lease_wedge_marks_dead(self):
+        """A serve replica whose grant lease exhausts is DEAD (the
+        controller's crash path evicts + fails over), never a silent
+        wedge holding the fleet."""
+        from deeplearning4j_tpu.serving.fleet.replica import ServeReplica
+
+        class _FakeServer:
+            pass
+
+        gate = threading.Event()
+        lease = GrantLease("replica", gate.wait,
+                           bounded=True, lease_s=0.02, max_reacquires=0,
+                           sleep=lambda s: None)
+        rep = ServeReplica("r0", model=None, server=_FakeServer(),
+                           lease=lease)
+        try:
+            rep.start()
+        finally:
+            gate.set()
+        assert rep.dead
+        assert rep.dead_reason.startswith("grant wedged")
+
+
+# ---------------------------------------------------------------------------
+# the chaos soak: preempt + wedge + straggle + evict across a fused run
+# ---------------------------------------------------------------------------
+
+
+def _run_chaos_soak(tmp_path, *, epochs, n_batches, straggle_ms):
+    """One soak round. Schedule, all deterministic:
+
+    - chunk 2: PREEMPT (injected ``preempt.chunk`` latch) — checkpoint
+      and stop; the relaunch re-acquires its backend grant through a
+      lease whose FIRST attempt WEDGES (injected ``grant.lease`` fault)
+      and is rescued (booked grant_wait, ``grant.reacquired`` on the
+      timeline);
+    - every chunk: STRAGGLE (injected ``epoch.chunk`` delay);
+    - mid-relaunch: the autopilot sees a straggler fleet member and a
+      goodput collapse, EVICTS the member through the trainer's
+      evidence-logged path and RESHARDS the run onto the 8-device mesh
+      via ``request_reshard`` (the elastic path) at the next chunk
+      boundary.
+
+    Returns (baseline_net, soaked_net, lease, trainer, decisions).
+    """
+    from deeplearning4j_tpu.resilience.faults import delay
+
+    data = [_ff_data(8, seed=i) for i in range(n_batches)]
+
+    baseline = _ff_net()
+    baseline.fit_epochs(ListDataSetIterator(list(data), 8), epochs,
+                        chunk_epochs=1)
+
+    # --- incarnation 1: straggling chunks, preempted at chunk 2
+    net = _ff_net()
+    trainer = FaultTolerantTrainer(net, str(tmp_path))
+    with inject("preempt.chunk", fail_nth(2)), \
+            inject("epoch.chunk", delay(straggle_ms)):
+        trainer.fit_epochs(ListDataSetIterator(list(data), 8), epochs,
+                           chunk_epochs=1)
+    assert trainer.preempted
+    assert net._epoch_cursor == 2
+
+    # --- relaunch: the backend grant wedges once and the lease rescues
+    lease = GrantLease("soak.backend", lambda: "grant", bounded=False,
+                       max_reacquires=2, base_backoff_s=0.05,
+                       sleep=time.sleep)
+    with inject("grant.lease", fail_times(1)):
+        assert lease.acquire() == "grant"
+    assert lease.reacquires == 1
+
+    net2 = _ff_net()
+    trainer2 = FaultTolerantTrainer(net2, str(tmp_path))
+    assert trainer2.resume()
+
+    # --- the autopilot acts on the fleet evidence: evict the wedged
+    # member through the trainer-style evidence path, reshard the run
+    tracker = InMemoryStateTracker()
+    from deeplearning4j_tpu.parallel.workrouter import (
+        IterativeReduceWorkRouter)
+
+    dt = DistributedTrainer(tracker, IterativeReduceWorkRouter(tracker),
+                            performer_factory=lambda: None,
+                            num_workers=1)
+    ap = GoodputAutopilot(
+        floor=goodput_floor(), silence_s=0.05,
+        evict=lambda w, d: dt.evict_worker(w, decision=d),
+        reshard=lambda healthy, d: net2.request_reshard(build_mesh()))
+    tracker.heartbeat("worker-1", metrics={"step_s": 9.0,
+                                           "goodput_pct": 5.0})
+    time.sleep(0.08)
+    decisions = ap.observe(
+        {"worker-1": {"step_s": 9.0, "goodput_pct": 5.0}},
+        last_beat={w: tracker.last_heartbeat(w)
+                   for w in tracker.workers()})
+    assert {d.action for d in decisions} == {"evict", "reshard"}
+
+    with inject("epoch.chunk", delay(straggle_ms)):
+        trainer2.fit_epochs(ListDataSetIterator(list(data), 8), epochs,
+                            chunk_epochs=1)
+    assert not trainer2.preempted
+    return baseline, net2, lease, dt, decisions
+
+
+@pytest.mark.chaos
+class TestChaosSoak:
+    def test_bounded_soak_1e6_goodput_and_evidence(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("DL4J_GOODPUT_FLOOR", "20")
+        set_run_ledger(RunLedger())
+        mark = len(tracer().spans())
+        try:
+            baseline, soaked, lease, dt, decisions = _run_chaos_soak(
+                tmp_path, epochs=5, n_batches=3, straggle_ms=5)
+        finally:
+            ledger = run_ledger()
+            report = ledger.report()
+            set_run_ledger(None)
+
+        # final state within 1e-6 of the unfaulted run (preempt+resume
+        # is bitwise; the mid-run reshard is 1e-6 by contract)
+        _assert_close(baseline.params, soaked.params)
+        _assert_close(baseline.updater_state, soaked.updater_state)
+        assert baseline.iteration_count == soaked.iteration_count
+
+        # the wedge was re-acquired and booked as grant_wait badput
+        assert lease.reacquires == 1
+        assert report["states"]["grant_wait"] >= 0.04
+        spans = _new_spans(mark)
+        assert any(s.name == "grant.reacquired" for s in spans)
+
+        # ledger-proven goodput above the configured floor; the runs
+        # themselves closed clean/stopped, never error
+        assert report["goodput_pct"] is not None
+        assert report["goodput_pct"] >= 20.0
+        statuses = {r["status"] for r in report["runs"]}
+        assert statuses <= {"clean", "stopped"}
+        # straggle badput was observed (the injected chunk delays ran
+        # inside run windows, keeping goodput below 100)
+        assert report["goodput_pct"] < 100.0
+
+        # every autopilot decision evidence-logged: the decision events
+        # carry the triggering gauges, and the eviction went through the
+        # master-tick evidence path
+        dec_events = [s for s in spans if s.name == "autopilot.decision"]
+        assert len(dec_events) == len(decisions) == 2
+        assert all("reason" in s.attrs for s in dec_events)
+        evict = [d for d in decisions if d.action == "evict"][0]
+        assert evict.gauges["goodput_pct"] == 5.0
+        assert len(dt.eviction_log) == 1
+        assert dt.eviction_log[0]["reason"] == (
+            "autopilot:heartbeat_silence")
+        # the reshard decision was APPLIED through the elastic path
+        assert any(s.name == "reshard.elastic" for s in spans)
+
+    @pytest.mark.slow
+    def test_full_length_soak(self, tmp_path, monkeypatch):
+        """The long form: three preempt/resume incarnations, a wedge +
+        rescue before each relaunch, straggling chunks throughout, a
+        grow AND a shrink reshard — still 1e-6 and above the floor."""
+        from deeplearning4j_tpu.resilience.faults import delay
+
+        monkeypatch.setenv("DL4J_GOODPUT_FLOOR", "20")
+        epochs, n_batches = 12, 4
+        data = [_ff_data(8, seed=i) for i in range(n_batches)]
+        baseline = _ff_net()
+        baseline.fit_epochs(ListDataSetIterator(list(data), 8), epochs,
+                            chunk_epochs=1)
+
+        set_run_ledger(RunLedger())
+        try:
+            net = _ff_net()
+            trainer = FaultTolerantTrainer(net, str(tmp_path))
+            with inject("preempt.chunk", fail_nth(3)), \
+                    inject("epoch.chunk", delay(10)):
+                trainer.fit_epochs(ListDataSetIterator(list(data), 8),
+                                   epochs, chunk_epochs=1)
+            assert trainer.preempted
+            total_reacquires = 0
+            meshes = [build_mesh(), None, build_mesh(
+                devices=jax.devices()[:4])]
+            for round_i, mesh in enumerate(meshes):
+                lease = GrantLease(f"soak.r{round_i}", lambda: "ok",
+                                   bounded=False, max_reacquires=2,
+                                   base_backoff_s=0.02, sleep=time.sleep)
+                with inject("grant.lease", fail_times(1)):
+                    lease.acquire()
+                total_reacquires += lease.reacquires
+                net = _ff_net()
+                trainer = FaultTolerantTrainer(net, str(tmp_path))
+                assert trainer.resume()
+                net.request_reshard(mesh)
+                preempt_at = 3 if round_i < len(meshes) - 1 else 10 ** 6
+                with inject("preempt.chunk", fail_nth(preempt_at)), \
+                        inject("epoch.chunk", delay(10)):
+                    trainer.fit_epochs(
+                        ListDataSetIterator(list(data), 8), epochs,
+                        chunk_epochs=1)
+                if round_i < len(meshes) - 1:
+                    assert trainer.preempted
+            assert not trainer.preempted
+            report = run_ledger().report()
+        finally:
+            set_run_ledger(None)
+
+        _assert_close(baseline.params, net.params)
+        assert baseline.iteration_count == net.iteration_count
+        assert total_reacquires == len(meshes)
+        assert report["goodput_pct"] is not None
+        assert report["goodput_pct"] >= 20.0
+        assert report["states"]["grant_wait"] > 0

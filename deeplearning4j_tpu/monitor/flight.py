@@ -427,6 +427,13 @@ WEDGE_EVIDENCE_NAMES = ("watchdog.stall", "grant.watchdog")
 #: was re-acquired (resilience/lease.py). A run that then finishes clean
 #: classifies as ``reacquired`` — clean-with-recovery, not wedged.
 REACQUIRE_EVIDENCE_NAMES = ("grant.reacquired",)
+#: serve-fleet overload evidence: a graceful drain (planned retire with
+#: KV-slab migration) vs. overload shedding (deadline/displacement
+#: drops). Both are ORDERLY endings — the run closed clean — but a
+#: postmortem must distinguish "we chose to shrink" and "we shed load"
+#: from a genuinely uneventful run.
+DRAIN_EVIDENCE_NAMES = ("serve.drain",)
+SHED_EVIDENCE_NAMES = ("serve.shed",)
 #: factor of the heartbeat interval after which continued beats with no
 #: progress classify as a wedge
 WEDGE_SILENCE_FACTOR = 3.0
@@ -471,6 +478,20 @@ def _is_reacquire_evidence(rec: dict) -> bool:
             and rec.get("name") in REACQUIRE_EVIDENCE_NAMES)
 
 
+def _is_drain_evidence(rec: dict) -> bool:
+    if rec.get("kind") in DRAIN_EVIDENCE_NAMES:
+        return True
+    return (rec.get("kind") == "span"
+            and rec.get("name") in DRAIN_EVIDENCE_NAMES)
+
+
+def _is_shed_evidence(rec: dict) -> bool:
+    if rec.get("kind") in SHED_EVIDENCE_NAMES:
+        return True
+    return (rec.get("kind") == "span"
+            and rec.get("name") in SHED_EVIDENCE_NAMES)
+
+
 def _is_progress(rec: dict) -> bool:
     return (rec.get("kind") not in _NON_PROGRESS_KINDS
             and not _is_wedge_evidence(rec))
@@ -502,6 +523,13 @@ def classify_end_state(records: List[dict],
       lease rescued it. Operationally clean-with-recovery — the round
       survived — but flagged so a fleet quietly re-acquiring every run
       is visible, not folded into ``clean``.
+    - ``drained``  — clean-and-planned: the timeline carries
+      ``serve.drain`` evidence (a replica was gracefully retired with
+      its streams migrated). Outranks ``shed-overload`` — the
+      operator's decision names the run.
+    - ``shed-overload`` — clean-but-degraded: the run closed orderly
+      but ``serve.shed`` evidence shows load was dropped (deadline
+      expiry or criticality displacement) on the way.
     """
     if not records:
         return {"end_state": "unknown", "evidence": "no records survived"}
@@ -553,6 +581,21 @@ def classify_end_state(records: List[dict],
         if reacquires:
             evidence["n_reacquires"] = reacquires
             return {"end_state": "reacquired", "evidence": evidence,
+                    "status": status}
+        # serve-fleet orderly variants, most deliberate first: a
+        # PLANNED drain outranks shedding (a drained run that also
+        # shed classifies by the operator's decision, with the shed
+        # count still in the evidence)
+        drains = sum(1 for r in records if _is_drain_evidence(r))
+        sheds = sum(1 for r in records if _is_shed_evidence(r))
+        if sheds:
+            evidence["n_sheds"] = sheds
+        if drains:
+            evidence["n_drains"] = drains
+            return {"end_state": "drained", "evidence": evidence,
+                    "status": status}
+        if sheds:
+            return {"end_state": "shed-overload", "evidence": evidence,
                     "status": status}
         return {"end_state": "clean", "evidence": evidence,
                 "status": status}

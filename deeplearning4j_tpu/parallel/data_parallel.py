@@ -39,7 +39,8 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.updater import apply_updater, lr_policy_scale
 
 logger = logging.getLogger(__name__)
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, build_mesh
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MeshSpec, build_mesh)
 
 
 class ParallelWrapper:
@@ -87,6 +88,31 @@ class ParallelWrapper:
             net.params = jax.device_put(net.params, repl)
             net.updater_state = jax.device_put(net.updater_state, repl)
         net.net_state = jax.device_put(net.net_state, repl)
+
+    def request_reshard(self, mesh) -> None:
+        """Request a mid-run elastic reshard of an in-flight
+        ``fit_epochs`` run (``None`` = back to one device). Forwards to
+        the wrapped network — the chunk driver reads the pending-mesh
+        latch off the network — and the wrapper's own reshard callback
+        re-pins its per-mesh programs at the next chunk boundary."""
+        self.network.request_reshard(mesh)
+
+    def _apply_reshard(self, mesh, cache) -> None:
+        """The chunk driver's reshard actuator for the wrapper path:
+        snapshot the trainable state to host, swap the wrapper onto the
+        new mesh, drop every per-mesh artifact (epoch programs with
+        pinned out_shardings, the FSDP re-jitted step, FSDP sharding
+        specs), re-place state, and re-place the dataset cache. Values
+        are untouched — only placement changes."""
+        net = self.network
+        net.params, net.updater_state, net.net_state = jax.device_get(
+            (net.params, net.updater_state, net.net_state))
+        self.mesh = mesh if mesh is not None else build_mesh(
+            MeshSpec(data=1), devices=jax.devices()[:1])
+        self._epoch_steps.clear()
+        self.__dict__.pop("_fsdp_train_step", None)
+        self._place_params()
+        cache.respec(self.mesh)
 
     @functools.cached_property
     def _fsdp_train_step(self):
@@ -334,9 +360,12 @@ class ParallelWrapper:
         guard = nan_guard_policy() if guard is None else guard
         guarded = guard != "off"
         stride = fused_metrics_stride(telemetry)
-        step = self._epoch_program(shuffle, accum, guarded, stride)
 
         def launch(epoch_keys):
+            # resolved per launch, not per run: a mid-run elastic
+            # reshard clears the program cache and this must pick up
+            # the program re-pinned to the NEW mesh
+            step = self._epoch_program(shuffle, accum, guarded, stride)
             with self.mesh:
                 if multi:
                     out = step(
@@ -392,10 +421,11 @@ class ParallelWrapper:
                         p, u, s, _, loss = net._train_step(*args, None)
             return p, u, s, loss
 
-        return drive_epoch_chunks(net, cache, num_epochs, chunk_epochs,
-                                  launch, shuffle=shuffle, guard=guard,
-                                  replay_step=replay_step,
-                                  on_chunk=on_chunk)
+        return drive_epoch_chunks(
+            net, cache, num_epochs, chunk_epochs, launch,
+            shuffle=shuffle, guard=guard, replay_step=replay_step,
+            on_chunk=on_chunk,
+            reshard=lambda new_mesh: self._apply_reshard(new_mesh, cache))
 
     def output(self, x):
         x = np.asarray(x)

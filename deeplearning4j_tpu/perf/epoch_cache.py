@@ -783,9 +783,7 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
         # flush even when the raise policy aborts the run mid-chunk: a
         # TrainingDivergedError handler reads the history that tripped it
         if metrics_chunks:
-            net._last_metrics = (metrics_chunks[0]
-                                 if len(metrics_chunks) == 1
-                                 else jnp.concatenate(metrics_chunks))
+            net._last_metrics = _concat_chunks(metrics_chunks)
         if sentinel_chunks:
             with tracer().span("epoch.readback", what="sentinel_flush"):
                 full = np.concatenate([np.asarray(t)
@@ -804,7 +802,25 @@ def drive_epoch_chunks(net, cache, num_epochs: int,
                     if run_error is not None
                     else ("stopped" if stopped else "clean")),
             model=model_name, epochs_done=done)
-    return history[0] if len(history) == 1 else jnp.concatenate(history)
+    return _concat_chunks(history)
+
+
+def _concat_chunks(chunks):
+    """Concatenate per-chunk device arrays along axis 0. Chunks from a
+    run that resharded mid-way can be COMMITTED to different device
+    sets (programs with pinned out_shardings, e.g. ParallelWrapper's);
+    jnp.concatenate refuses mixed placements, so those gather to host
+    once and concatenate there — the caller is about to read the
+    history anyway."""
+    import jax.numpy as jnp
+
+    if len(chunks) == 1:
+        return chunks[0]
+    try:
+        return jnp.concatenate(chunks)
+    except ValueError:
+        return jnp.asarray(np.concatenate(
+            [np.asarray(c) for c in chunks]))
 
 
 def _enforce_nan_guard(net, policy: str, trips: np.ndarray,

@@ -53,16 +53,24 @@ from deeplearning4j_tpu.serving.kv_cache import (  # noqa: F401
 )
 from deeplearning4j_tpu.serving.engine import DecodeEngine  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
+    CRITICALITIES,
     AdmissionVerdict,
     RequestQueue,
+    RetryBudget,
     ServeQueueFull,
     ServeRequest,
+    criticality_rank,
+    request_cost,
+    serve_deadline_s,
     serve_draft_layers,
     serve_evict_s,
     serve_fuse_steps,
+    serve_hedge_s,
     serve_kv_dtype,
     serve_max_queue,
     serve_replicas,
+    serve_retry_burst,
+    serve_retry_ratio,
     serve_role,
     serve_slots,
 )
@@ -75,12 +83,15 @@ from deeplearning4j_tpu.serving.loadgen import (  # noqa: F401
 )
 
 __all__ = [
-    "AdmissionVerdict", "Arrival", "DecodeEngine", "DecodeServer",
-    "LoadReport", "RequestQueue", "ServeQueueFull", "ServeRequest",
-    "SlotKVCache", "compile_cache_dir", "compile_cache_stats",
+    "AdmissionVerdict", "Arrival", "CRITICALITIES", "DecodeEngine",
+    "DecodeServer", "LoadReport", "RequestQueue", "RetryBudget",
+    "ServeQueueFull", "ServeRequest", "SlotKVCache",
+    "compile_cache_dir", "compile_cache_stats", "criticality_rank",
     "ensure_compile_cache", "kv_pool_nbytes", "max_slots_in_budget",
-    "poisson_schedule", "resolve_kv_dtype", "run_open_loop",
-    "serve_draft_layers", "serve_evict_s", "serve_fuse_steps",
-    "serve_kv_dtype", "serve_max_queue", "serve_replicas", "serve_role",
+    "poisson_schedule", "request_cost", "resolve_kv_dtype",
+    "run_open_loop", "serve_deadline_s", "serve_draft_layers",
+    "serve_evict_s", "serve_fuse_steps", "serve_hedge_s",
+    "serve_kv_dtype", "serve_max_queue", "serve_replicas",
+    "serve_retry_burst", "serve_retry_ratio", "serve_role",
     "serve_slots",
 ]

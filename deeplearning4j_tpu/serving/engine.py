@@ -580,6 +580,13 @@ class DecodeEngine:
                 "prefill_buckets": pre,
                 "total": self.program_builds}
 
+    def cursor_of(self, slot: int) -> int:
+        """Host readback of one slot's live cursor — sanctioned ONLY at
+        migration boundaries (graceful drain exports a mid-stream slot
+        once per request, like the prefill/decode handoff's export),
+        never inside the decode loop where cursors advance on device."""
+        return int(np.asarray(self.cache.cursors)[slot])
+
     # ------------------------------------------------------------------
     def prompt_bucket(self, n: int) -> int:
         return prompt_bucket(n, self.buckets, max_len=self.max_len)

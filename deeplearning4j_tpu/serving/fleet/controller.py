@@ -24,6 +24,11 @@ training workers, on the same PR-9 heartbeat channel:
   :meth:`FleetRouter.failover` (``serve.failover`` span). The
   correctness contract rides on deterministic prefill: a killed
   replica's requests complete token-identical to an unfailed run.
+- **drain** — :meth:`FleetController.drain` is the PLANNED way out:
+  quiesce admission, stop the step loop, migrate in-flight streams to
+  survivors wholesale (KV slab + cursor + RNG via the handoff path —
+  zero recompute, zero lost tokens), retire the replica. Eviction is
+  for corpses; drain is for maintenance.
 """
 
 from __future__ import annotations
@@ -87,6 +92,10 @@ class FleetController:
         self.stragglers: set = set()
         self.evicted: List[str] = []
         self.eviction_log: List[dict] = []
+        self.drained: List[str] = []
+        self.drain_log: List[dict] = []
+        # tick-skip set: drained replicas join it too (retired is not
+        # crashed, but neither reports as current)
         self._evicted_set: set = set()
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
@@ -148,6 +157,7 @@ class FleetController:
         self._reg.gauge("fleet_serve_stragglers").set(
             float(len(self.stragglers)))
         self.router.retry_pending()
+        self.router.maybe_hedge()
         return fleet
 
     # ------------------------------------------------------------------
@@ -206,6 +216,45 @@ class FleetController:
         summary = self.router.failover(replica_id, reason=reason)
         decision["failover"] = summary
         self.eviction_log.append(decision)
+        return decision
+
+    def drain(self, replica_id: str, *,
+              reason: str = "operator_drain") -> dict:
+        """Gracefully retire one replica: quiesce admission, stop its
+        step loop, migrate every in-flight stream to survivors via
+        KV-slab handoff (zero recompute, zero lost tokens — contrast
+        :meth:`evict`, which re-prefills because a dead replica's KV is
+        gone), and mark it retired. Evidence-logged like an eviction;
+        idempotent against evict/drain races the same way."""
+        if replica_id in self._evicted_set:
+            return {"replica": replica_id, "reason": "already_evicted"}
+        replica = self.router._by_id.get(replica_id)
+        if replica is None:
+            raise KeyError(f"unknown replica {replica_id!r}")
+        self._evicted_set.add(replica_id)
+        self.drained.append(replica_id)
+        self.stragglers.discard(replica_id)
+        # 1) no new work lands on it (placement, spill, hedges,
+        #    affinity all skip a quiesced replica)
+        self.router.quiesce(replica_id)
+        # 2) stop the step loop CLEANLY before touching device state —
+        #    migrate_out exports live cursors a concurrent step would
+        #    advance; retired is not dead, so no failover fires
+        replica.retire()
+        # 3) move everything off with zero recompute
+        summary = self.router.migrate_out(replica_id)
+        for gauge in _REPLICA_GAUGES:
+            self._reg.gauge(gauge).remove(replica=replica_id)
+        migrated = (summary["handoffs"] + summary["queued"]
+                    + summary["live"])
+        decision = {"replica": replica_id, "reason": reason,
+                    "t_wall": self.clock(), "migrated": migrated,
+                    **summary}
+        record_counter("fleet_serve_drains_total", replica=replica_id)
+        self._reg.gauge("serve_drain_migrated").set(
+            float(migrated), replica=replica_id)
+        tracer().event("serve.drain", **decision)
+        self.drain_log.append(decision)
         return decision
 
     # ------------------------------------------------------------------

@@ -88,25 +88,34 @@ class FleetLoadDriver:
     def run(self, schedule: List[Arrival], *,
             kill_at_s: Optional[float] = None,
             kill_replica: Optional[str] = None,
+            drain_at_s: Optional[float] = None,
+            drain_replica: Optional[str] = None,
             max_events: int = 2_000_000) -> LoadReport:
         """Drive the schedule to completion. With ``kill_at_s`` /
         ``kill_replica`` set, that replica dies at the first event past
         the virtual time and the controller (required then) evicts +
-        fails over; the report still covers every request. Returns the
-        standard :class:`LoadReport` read off the virtual timelines."""
-        if kill_at_s is not None:
+        fails over; ``drain_at_s`` / ``drain_replica`` instead retire
+        the replica GRACEFULLY at that instant (quiesce + KV-slab
+        migration, zero recompute). The report still covers every
+        request. Returns the standard :class:`LoadReport` read off the
+        virtual timelines."""
+        for t_s, rid, what in ((kill_at_s, kill_replica, "kill"),
+                               (drain_at_s, drain_replica, "drain")):
+            if t_s is None:
+                continue
             if self.controller is None:
-                raise ValueError("kill_at_s needs a controller to evict "
-                                 "the victim and requeue its requests")
-            if kill_replica not in self.router._by_id:
+                raise ValueError(f"{what}_at_s needs a controller")
+            if rid not in self.router._by_id:
                 raise ValueError(
-                    f"kill_replica={kill_replica!r} is not in the fleet "
+                    f"{what}_replica={rid!r} is not in the fleet "
                     f"({sorted(self.router._by_id)})")
         report = LoadReport()
         i = 0
-        killed = False
+        killed = drained = False
         self.failover_done_s: Optional[float] = None
         self.kill_time_s: Optional[float] = None
+        self.drain_time_s: Optional[float] = None
+        self.drain_summary: Optional[dict] = None
         failover_victims: List = []
         for _ in range(max_events):
             alive = [r for r in self.router.replicas if r.alive]
@@ -153,16 +162,45 @@ class FleetLoadDriver:
                         self.vt[rr.replica_id] = max(
                             self.vt[rr.replica_id], self._now)
                 continue
+            # ---- scheduled drain: graceful retire, mid-storm
+            if (not drained and drain_at_s is not None
+                    and self._now >= drain_at_s):
+                drained = True
+                self.drain_time_s = self._now
+                # migrated streams continue no earlier than the later
+                # of the drain instant and the victim's own frontier
+                # (its already-booked steps produced those tokens)
+                t_resume = max(self._now, self.vt[drain_replica])
+                self.drain_summary = self.controller.drain(
+                    drain_replica, reason="bench-drain")
+                for rr in self.router.replicas:
+                    if rr.alive and rr.busy():
+                        self.vt[rr.replica_id] = max(
+                            self.vt[rr.replica_id], t_resume)
+                continue
+            # hedging rides the driver loop the way it rides the
+            # controller tick in real-time fleets
+            if self.router.maybe_hedge():
+                for rr in self.router.replicas:
+                    if rr.alive and rr.busy():
+                        self.vt[rr.replica_id] = max(
+                            self.vt[rr.replica_id], self._now)
             if kind == "arrive":
                 a = schedule[i]
                 i += 1
+                deadline = (None if a.deadline_s is None
+                            else self._now + a.deadline_s)
                 freq = self.router.try_submit(
-                    a.prompt, a.max_new_tokens, seed=a.seed)
+                    a.prompt, a.max_new_tokens, seed=a.seed,
+                    deadline_s=deadline, criticality=a.criticality)
                 if freq is None:
                     report.rejected += 1
                     report.drop_times_s.append(self._now)
                 else:
                     report.submitted += 1
+                    report.submitted_by_class[a.criticality] = (
+                        report.submitted_by_class.get(a.criticality, 0)
+                        + 1)
                 # whoever just went from idle to busy resumes its
                 # timeline here, not in its past
                 for rr in self.router.replicas:
@@ -192,17 +230,36 @@ class FleetLoadDriver:
         # ---- fold the fleet's request ledger into the report
         report.wall_s = max([self._now] + list(self.vt.values()))
         for fr in self.router.requests:
+            report.placements += fr.attempts
+            if fr.state == "shed":
+                # admitted then shed (deadline or displacement): joins
+                # the drop series at the instant the decision was made
+                report.shed += 1
+                report.shed_by_class[fr.criticality] = (
+                    report.shed_by_class.get(fr.criticality, 0) + 1)
+                if fr.finish_s is not None:
+                    report.drop_times_s.append(fr.finish_s)
+                continue
             if not fr.finished:
                 continue
             report.finished += 1
+            report.finished_by_class[fr.criticality] = (
+                report.finished_by_class.get(fr.criticality, 0) + 1)
             report.tokens += len(fr.tokens)
             if fr.latency_s is not None:
                 report.latencies_s.append(fr.latency_s)
             if fr.ttft_s is not None:
                 report.ttfts_s.append(fr.ttft_s)
+                report.ttfts_by_class.setdefault(
+                    fr.criticality, []).append(fr.ttft_s)
             if fr.first_token_s is not None and fr.finish_s is not None \
                     and len(fr.tokens) > 1:
                 report.tpots_s.append(
                     (fr.finish_s - fr.first_token_s)
                     / (len(fr.tokens) - 1))
+        report.hedges = len(self.router.hedge_log)
+        for r in self.router.replicas:
+            s = r.server.stats()
+            report.expired_in_queue += s.get("expired_in_queue", 0)
+            report.expired_in_flight += s.get("expired_in_flight", 0)
         return report

@@ -34,7 +34,8 @@ import numpy as np
 
 from deeplearning4j_tpu.analysis.annotations import traced
 
-__all__ = ["SlotHandoff", "export_slot", "install_slot", "make_install"]
+__all__ = ["SlotHandoff", "export_slot", "export_live_slot",
+           "install_slot", "make_install"]
 
 
 @traced
@@ -74,8 +75,12 @@ class SlotHandoff:
     fields the install validates against the target pool."""
 
     slabs: Dict[str, np.ndarray]   # k/v [L, T, Hkv, Dh] (+ *_scale [L, Hkv])
-    cursor: int                    # next write position (== prompt_len)
+    # next write position: prompt_len for a prefill handoff,
+    # prompt_len + emitted for a drain-time mid-stream migration
+    cursor: int
     key: np.ndarray                # per-slot RNG key, mid-chain
+    # the last token fed back into decode: the prefill's first sampled
+    # token, or — mid-stream — the newest token the source emitted
     first_token: int
     kv_dtype: str
     max_len: int
@@ -124,6 +129,24 @@ def install_slot(engine, slot: int, handoff: SlotHandoff):
     engine.cache.install(state)
     engine.cache.set_cursor(slot, handoff.cursor)
     return jnp.asarray(handoff.key)
+
+
+def export_live_slot(server, slot: int) -> SlotHandoff:
+    """Package a RUNNING slot's full decode state for migration — the
+    graceful-drain counterpart of the prefill-side handoff. The slab
+    covers every token decoded so far (cursor = prompt_len + emitted),
+    the RNG key is the slot's mid-chain key, and ``first_token`` is the
+    newest emitted token — installing this on a survivor continues the
+    stream with ZERO recompute and zero lost tokens, where failover
+    would re-prefill prompt + emitted from scratch."""
+    engine = server.engine
+    return SlotHandoff(
+        slabs=export_slot(engine, slot),
+        cursor=engine.cursor_of(slot),
+        key=np.asarray(server._keys[slot]),
+        first_token=int(server._last_tok[slot]),
+        kv_dtype=engine.kv_dtype,
+        max_len=engine.max_len)
 
 
 def make_install(handoff: SlotHandoff):

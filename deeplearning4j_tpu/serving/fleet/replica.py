@@ -78,6 +78,10 @@ class ServeReplica:
         self.monitor = None
         self.dead = False
         self.dead_reason: Optional[str] = None
+        # planned removal (graceful drain): alive goes False without
+        # the dead flag — drained is not crashed, and the controller's
+        # crash-evict pass must not treat it as a corpse
+        self.retired = False
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         # prefill-role work: (FleetRequest, on_handoff) jobs the router
@@ -100,7 +104,7 @@ class ServeReplica:
     # ------------------------------------------------------------------
     @property
     def alive(self) -> bool:
-        return not self.dead
+        return not self.dead and not self.retired
 
     def ttft_p50(self) -> Optional[float]:
         return statistics.median(self._ttfts) if self._ttfts else None
@@ -318,6 +322,14 @@ class ServeReplica:
             self._stop.set()
         if self.monitor is not None:
             self.monitor.stop()
+
+    def retire(self) -> None:
+        """Planned removal (graceful drain): clean shutdown PLUS the
+        retired flag, so ``alive`` goes False — the router stops
+        placing, the driver stops stepping — without the dead flag a
+        crash would raise."""
+        self.stop()
+        self.retired = True
 
     def stop(self) -> None:
         """Clean shutdown (not an eviction): loop joined, beats off."""

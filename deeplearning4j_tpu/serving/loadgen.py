@@ -31,30 +31,52 @@ class Arrival:
     prompt: np.ndarray
     max_new_tokens: int
     seed: int
+    # RELATIVE deadline budget (seconds from arrival); the driver
+    # converts to the absolute instant at submit. None = no deadline.
+    deadline_s: Optional[float] = None
+    criticality: str = "interactive"
 
 
 def poisson_schedule(n_requests: int, rate_rps: float, *,
                      vocab_size: int,
                      prompt_lens: Sequence[int] = (8, 16, 24, 48),
                      max_new_tokens: Sequence[int] = (4, 8, 16),
+                     criticality_mix: Optional[dict] = None,
+                     deadlines_s: Optional[dict] = None,
                      seed: int = 0) -> List[Arrival]:
     """Ragged request stream: exponential interarrivals at ``rate_rps``,
     prompt lengths / generation lengths drawn uniformly from the given
     menus (several ladder rungs on purpose — the compile-flatness claim
-    is only interesting under shape raggedness)."""
+    is only interesting under shape raggedness).
+
+    ``criticality_mix`` maps class -> weight (e.g. ``{"interactive":
+    0.3, "batch": 0.7}``; default all-interactive) and ``deadlines_s``
+    maps class -> RELATIVE deadline budget (classes absent get none) —
+    together they shape the overload-storm workloads the serve-SLO soak
+    drives."""
     if n_requests < 1 or rate_rps <= 0:
         raise ValueError("need n_requests >= 1 and rate_rps > 0")
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(1.0 / rate_rps, n_requests)
     arrivals = np.cumsum(gaps)
+    classes, weights = None, None
+    if criticality_mix:
+        classes = list(criticality_mix)
+        total = float(sum(criticality_mix.values()))
+        weights = [criticality_mix[c] / total for c in classes]
+    deadlines_s = deadlines_s or {}
     out = []
     for i in range(n_requests):
         plen = int(rng.choice(prompt_lens))
+        crit = (str(rng.choice(classes, p=weights))
+                if classes else "interactive")
         out.append(Arrival(
             arrival_s=float(arrivals[i]),
             prompt=rng.integers(0, vocab_size, plen, dtype=np.int32),
             max_new_tokens=int(rng.choice(max_new_tokens)),
-            seed=int(rng.integers(0, 2**31 - 1))))
+            seed=int(rng.integers(0, 2**31 - 1)),
+            deadline_s=deadlines_s.get(crit),
+            criticality=crit))
     return out
 
 
@@ -78,6 +100,22 @@ class LoadReport:
     finished: int = 0
     tokens: int = 0
     wall_s: float = 0.0
+    # overload-control accounting: sheds (admitted then dropped by
+    # deadline/displacement — distinct from rejected-at-admission),
+    # split by class and by where the deadline caught them, plus
+    # per-class submission/completion/TTFT splits so the SLO gate can
+    # assert "interactive held while batch absorbed the storm"
+    shed: int = 0
+    shed_by_class: dict = field(default_factory=dict)
+    expired_in_queue: int = 0
+    expired_in_flight: int = 0
+    submitted_by_class: dict = field(default_factory=dict)
+    finished_by_class: dict = field(default_factory=dict)
+    ttfts_by_class: dict = field(default_factory=dict)
+    # retry-amplification evidence: total placements (first + re-
+    # dispatch) and hedges across the run
+    placements: int = 0
+    hedges: int = 0
 
     @staticmethod
     def _pct(xs: List[float], q: float) -> Optional[float]:
@@ -106,6 +144,21 @@ class LoadReport:
             # shed load, accounted in time: the sorted drop timestamps
             "dropped_request_seconds": [round(t, 3)
                                         for t in sorted(self.drop_times_s)],
+            "shed": self.shed,
+            "shed_by_class": dict(self.shed_by_class),
+            "expired_in_queue": self.expired_in_queue,
+            "expired_in_flight": self.expired_in_flight,
+            "submitted_by_class": dict(self.submitted_by_class),
+            "finished_by_class": dict(self.finished_by_class),
+            "ttft_p50_ms_by_class": {
+                c: _r(self._pct(xs, 50), ms)
+                for c, xs in self.ttfts_by_class.items()},
+            # placements + hedges over submissions: the amplification
+            # the retry budget bounds (1.0 = no retries at all)
+            "retry_amplification": (
+                round((self.placements + self.hedges)
+                      / self.submitted, 3)
+                if self.submitted else None),
         }
 
 
@@ -134,8 +187,14 @@ def run_open_loop(server, schedule: List[Arrival], *,
             i += 1
             try_submit = getattr(server, "try_submit", None)
             if try_submit is not None:
+                # the arrival's deadline is a budget from NOW; the
+                # server wants the absolute instant on ITS clock axis
+                # (the same injected clock, before the t0 re-base)
+                deadline = (None if a.deadline_s is None
+                            else clock() + a.deadline_s)
                 verdict = try_submit(a.prompt, a.max_new_tokens,
-                                     seed=a.seed)
+                                     seed=a.seed, deadline_s=deadline,
+                                     criticality=a.criticality)
                 admitted = verdict.admitted
                 req = verdict.request
             else:
@@ -148,6 +207,8 @@ def run_open_loop(server, schedule: List[Arrival], *,
                     admitted, req = False, None
             if admitted:
                 report.submitted += 1
+                report.submitted_by_class[a.criticality] = (
+                    report.submitted_by_class.get(a.criticality, 0) + 1)
                 reqs.append(req)
             else:
                 # open loop drops, it does not retry — but it records
@@ -163,16 +224,34 @@ def run_open_loop(server, schedule: List[Arrival], *,
                 sleep(min(gap, 0.05) if gap > idle_wait_s else idle_wait_s)
     report.wall_s = clock() - t0
     for req in reqs:
+        if req.state == "shed":
+            # admitted, then dropped by deadline or displacement: the
+            # shed instant joins the drop series (t0-relative)
+            report.shed += 1
+            report.shed_by_class[req.criticality] = (
+                report.shed_by_class.get(req.criticality, 0) + 1)
+            if req.finish_s is not None:
+                report.drop_times_s.append(req.finish_s - t0)
+            continue
         if req.state != "finished":
             continue
         report.finished += 1
+        report.finished_by_class[req.criticality] = (
+            report.finished_by_class.get(req.criticality, 0) + 1)
         report.tokens += len(req.tokens)
         if req.latency_s is not None:
             report.latencies_s.append(req.latency_s)
         if req.ttft_s is not None:
             report.ttfts_s.append(req.ttft_s)
+            report.ttfts_by_class.setdefault(
+                req.criticality, []).append(req.ttft_s)
         if req.first_token_s is not None and req.finish_s is not None \
                 and len(req.tokens) > 1:
             report.tpots_s.append((req.finish_s - req.first_token_s)
                                   / (len(req.tokens) - 1))
+    stats = getattr(server, "stats", None)
+    if stats is not None:
+        s = stats()
+        report.expired_in_queue = s.get("expired_in_queue", 0)
+        report.expired_in_flight = s.get("expired_in_flight", 0)
     return report

@@ -48,8 +48,8 @@ from deeplearning4j_tpu.monitor import metrics, tracer
 from deeplearning4j_tpu.serving.engine import DecodeEngine
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionVerdict, RequestQueue, ServeQueueFull, ServeRequest,
-    serve_draft_layers, serve_fuse_steps, serve_kv_dtype,
-    serve_max_queue, serve_slots)
+    criticality_rank, serve_deadline_s, serve_draft_layers,
+    serve_fuse_steps, serve_kv_dtype, serve_max_queue, serve_slots)
 
 __all__ = ["DecodeServer"]
 
@@ -104,6 +104,13 @@ class DecodeServer:
         # (serving/fleet/handoff.py builds these)
         self._handoffs: Deque[Tuple[ServeRequest, Callable]] = deque()
         self.finished: List[ServeRequest] = []
+        # overload-control ledger: every shed request + the decision
+        # evidence behind it (mirrored to the serve.shed tracer event)
+        self.shed: List[ServeRequest] = []
+        self.shed_log: List[dict] = []
+        self.shed_by_class: dict = {}
+        self.expired_in_queue = 0
+        self.expired_in_flight = 0
         self.steps = 0
         self.decode_tokens = 0
         self.slot_dispatches = 0
@@ -121,25 +128,42 @@ class DecodeServer:
     # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int, *,
-               seed: int = 0) -> ServeRequest:
+    def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               deadline_s: Optional[float] = None,
+               criticality: str = "interactive") -> ServeRequest:
         """Enqueue one request. Validates against the slot capacity the
         way ``generate`` validates against its cache size; raises
         :class:`~.scheduler.ServeQueueFull` at the queue bound."""
-        verdict = self.try_submit(prompt, max_new_tokens, seed=seed)
+        verdict = self.try_submit(prompt, max_new_tokens, seed=seed,
+                                  deadline_s=deadline_s,
+                                  criticality=criticality)
         if not verdict.admitted:
             raise ServeQueueFull(
                 f"serve queue at max depth {self.queue.max_depth}")
         return verdict.request
 
     def try_submit(self, prompt, max_new_tokens: int, *,
-                   seed: int = 0) -> AdmissionVerdict:
+                   seed: int = 0,
+                   deadline_s: Optional[float] = None,
+                   criticality: str = "interactive",
+                   displace: bool = True) -> AdmissionVerdict:
         """Non-blocking ``submit``: returns an
         :class:`~.scheduler.AdmissionVerdict` instead of raising at the
         queue bound, so a routing frontend can place across replicas
         without exception-driven control flow. Malformed requests
-        (empty prompt, capacity overflow) still raise — those are
-        caller bugs, not load conditions."""
+        (empty prompt, capacity overflow, unknown criticality) still
+        raise — those are caller bugs, not load conditions.
+
+        ``deadline_s`` is the ABSOLUTE expiry instant on this server's
+        clock (None falls back to ``DL4J_SERVE_DEADLINE_S`` as a budget
+        from now); an already-expired submit is shed on the spot
+        (reason ``"expired"``). At the queue bound, ``displace=True``
+        lets this arrival shed the costliest queued request of a
+        strictly lower criticality class (the victim rides back on the
+        verdict's ``displaced`` field); the router's first placement
+        pass disables it so plain spill is tried fleet-wide before
+        anything is shed."""
+        criticality_rank(criticality)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("prompt must hold at least one token")
@@ -155,17 +179,75 @@ class DecodeServer:
                 + (f" (+ {slack} speculative slack)" if slack else "")
                 + f" exceeds the server's slot capacity "
                 f"max_len={self.max_len}")
+        now = self.clock()
+        if deadline_s is None:
+            budget = serve_deadline_s()
+            deadline_s = None if budget is None else now + budget
         req = ServeRequest(prompt=prompt, max_new_tokens=max_new_tokens,
-                           seed=seed)
-        req.submit_s = self.clock()
-        if not self.queue.try_push(req):
+                           seed=seed, deadline_s=deadline_s,
+                           criticality=criticality)
+        req.submit_s = now
+        if req.expired(now):
+            # a deadline already in the past: shed at the earliest
+            # possible point — before it ever costs a queue entry
+            self._shed(req, where="admission", reason="deadline", now=now)
             self._reg.counter("serve_requests_total").inc(event="rejected")
-            return AdmissionVerdict(admitted=False, reason="queue_full",
+            return AdmissionVerdict(admitted=False, reason="expired",
                                     queue_depth=len(self.queue))
+        if not self.queue.try_push(req):
+            victim = None
+            if displace:
+                admitted, victim = self.queue.displace(req)
+            else:
+                admitted = False
+            if not admitted:
+                self._reg.counter("serve_requests_total").inc(
+                    event="rejected")
+                return AdmissionVerdict(admitted=False,
+                                        reason="queue_full",
+                                        queue_depth=len(self.queue))
+            if victim is not None:
+                self._shed(victim, where="queue", reason="shed_overload",
+                           now=now, displaced_by=req.id)
+            self._reg.counter("serve_requests_total").inc(
+                event="submitted")
+            self._reg.gauge("serve_queue_depth").set(len(self.queue))
+            return AdmissionVerdict(admitted=True, request=req,
+                                    queue_depth=len(self.queue),
+                                    displaced=victim)
         self._reg.counter("serve_requests_total").inc(event="submitted")
         self._reg.gauge("serve_queue_depth").set(len(self.queue))
         return AdmissionVerdict(admitted=True, request=req,
                                 queue_depth=len(self.queue))
+
+    def _shed(self, req: ServeRequest, *, where: str, reason: str,
+              now: float, displaced_by: Optional[int] = None) -> None:
+        """Shed one request with its evidence: state flips to ``shed``,
+        the decision lands in ``shed_log`` AND on the tracer timeline
+        (``serve.shed`` event → flight recorder), and the
+        ``serve_shed_total`` counter / ``serve_shed_by_class`` gauge
+        move — nothing is dropped silently."""
+        req.state = "shed"
+        req.shed_reason = reason
+        req.finish_s = now    # when it was shed (drop-series timestamp)
+        self.shed.append(req)
+        self.shed_by_class[req.criticality] = (
+            self.shed_by_class.get(req.criticality, 0) + 1)
+        if where == "queue" and reason == "deadline":
+            self.expired_in_queue += 1
+        elif where == "in_flight":
+            self.expired_in_flight += 1
+        decision = {"request": req.id, "criticality": req.criticality,
+                    "where": where, "reason": reason, "t": now}
+        if displaced_by is not None:
+            decision["displaced_by"] = displaced_by
+        self.shed_log.append(decision)
+        self._reg.counter("serve_shed_total").inc(
+            criticality=req.criticality, where=where)
+        self._reg.gauge("serve_shed_by_class").set(
+            float(self.shed_by_class[req.criticality]),
+            criticality=req.criticality)
+        tracer().event("serve.shed", **decision)
 
     def admit_external(self, req: ServeRequest,
                        install: Callable) -> None:
@@ -244,7 +326,20 @@ class DecodeServer:
                 self._admit_handoff(slot)
                 admitted += 1
                 continue
+            # pop past corpses: an expired request sheds HERE — before
+            # its prefill burns the slot — and a canceled hedge loser
+            # vanishes without a trace in the finished ledger
             req = self.queue.pop()
+            while req is not None:
+                now = self.clock()
+                if req.canceled:
+                    req.state = "canceled"
+                elif req.expired(now):
+                    self._shed(req, where="queue", reason="deadline",
+                               now=now)
+                else:
+                    break
+                req = self.queue.pop()
             if req is None:
                 break
             with tracer().span("serve.prefill", request=req.id,
@@ -315,11 +410,31 @@ class DecodeServer:
         self.engine.cache.advance(live_mask)
         return np.asarray(toks)[None], None      # [1, S]
 
+    def _sweep_expired(self) -> None:
+        """The retirement loop's deadline check: an in-flight request
+        past its deadline frees its slot NOW (shed, ``in_flight``), and
+        a canceled hedge loser retires quietly — both before admission,
+        so the freed slots take new work this very boundary."""
+        now = self.clock()
+        for slot in self._live_slots():
+            req = self._slot_req[slot]
+            if req.canceled:
+                req.state = "canceled"
+                self._slot_req[slot] = None
+                self._reg.counter("serve_requests_total").inc(
+                    event="canceled")
+            elif req.expired(now):
+                self._slot_req[slot] = None
+                self._shed(req, where="in_flight", reason="deadline",
+                           now=now)
+
     def step(self) -> bool:
-        """One scheduler iteration: admit at the fusion boundary, then
-        one decode dispatch (1, K, or K speculative rounds of tokens).
-        Returns False when nothing was live (the caller may idle)."""
+        """One scheduler iteration: shed expired/canceled slots, admit
+        at the fusion boundary, then one decode dispatch (1, K, or K
+        speculative rounds of tokens). Returns False when nothing was
+        live (the caller may idle)."""
         with tracer().span("serve.step") as sp:
+            self._sweep_expired()
             self._admit()
             live = self._live_slots()
             self._reg.gauge("serve_queue_depth").set(len(self.queue))
@@ -409,6 +524,10 @@ class DecodeServer:
             "occupancy": self.occupancy(),
             "steps": self.steps,
             "finished": len(self.finished),
+            "shed": len(self.shed),
+            "shed_by_class": dict(self.shed_by_class),
+            "expired_in_queue": self.expired_in_queue,
+            "expired_in_flight": self.expired_in_flight,
             "fuse_steps": self.fuse_steps,
             "kv_dtype": self.engine.kv_dtype,
             "kv_pool_bytes": pool_bytes,

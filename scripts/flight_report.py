@@ -23,6 +23,12 @@ state:
   rescued by the lease protocol (resilience/lease.py) instead of
   costing the round. Counts as a healthy ending operationally, but is
   reported distinctly so chronic grant flapping stays visible
+- ``drained``  — clean-and-planned: ``serve.drain`` evidence shows a
+  replica was gracefully retired (streams migrated, zero recompute)
+- ``shed-overload`` — clean-but-degraded: ``serve.shed`` evidence
+  shows load was dropped (deadline expiry or criticality
+  displacement); the serve-overload section splits the sheds by
+  where the deadline caught them (queue vs in-flight)
 
 Usage:
     python scripts/flight_report.py <flight-dir>            # human report
@@ -84,6 +90,39 @@ def build_report(directory: str, recent: int = 25) -> dict:
         if k == "span":
             k = f"span:{r.get('name', '?')}"
         by_kind[k] = by_kind.get(k, 0) + 1
+    # serve-overload section: every shed/hedge/drain decision rides the
+    # timeline as an event — split the sheds by where they happened
+    # (queue-expiry vs in-flight expiry vs displacement) and count the
+    # drains, so a storm postmortem reads the WHOLE story from records
+    sheds = [r for r in records if r.get("kind") == "serve.shed"]
+    serve = {}
+    if sheds:
+        by_where: dict = {}
+        by_reason: dict = {}
+        for r in sheds:
+            by_where[r.get("where", "?")] = (
+                by_where.get(r.get("where", "?"), 0) + 1)
+            by_reason[r.get("reason", "?")] = (
+                by_reason.get(r.get("reason", "?"), 0) + 1)
+        serve["sheds"] = len(sheds)
+        serve["sheds_by_where"] = dict(sorted(by_where.items()))
+        serve["sheds_by_reason"] = dict(sorted(by_reason.items()))
+        serve["expired_in_queue"] = sum(
+            1 for r in sheds if r.get("where") == "queue"
+            and r.get("reason") == "deadline")
+        serve["expired_in_flight"] = sum(
+            1 for r in sheds if r.get("where") == "in_flight")
+    drains = [r for r in records if r.get("kind") == "serve.drain"]
+    if drains:
+        serve["drains"] = [
+            {"replica": r.get("replica"), "migrated": r.get("migrated"),
+             "fallback_failovers": r.get("fallback_failovers")}
+            for r in drains]
+    hedges = sum(1 for r in records if r.get("kind") == "serve.hedge")
+    if hedges:
+        serve["hedges"] = hedges
+        serve["hedge_wins"] = sum(
+            1 for r in records if r.get("kind") == "serve.hedge_win")
     return {
         "directory": directory,
         "end_state": verdict["end_state"],
@@ -93,6 +132,7 @@ def build_report(directory: str, recent: int = 25) -> dict:
         "n_runs_started": len(runs),
         "n_chunks_done": chunks,
         "by_kind": dict(sorted(by_kind.items())),
+        "serve_overload": serve or None,
         "timeline": records[-recent:],
     }
 
@@ -111,6 +151,20 @@ def print_report(report: dict, out=None) -> None:
     if ev.get("n_reacquires"):
         print(f"reacquires : {ev['n_reacquires']} wedged grant(s) "
               "rescued by the lease protocol", file=out)
+    serve = report.get("serve_overload")
+    if serve:
+        if serve.get("sheds"):
+            print(f"sheds      : {serve['sheds']} "
+                  f"(queue-expired {serve.get('expired_in_queue', 0)}, "
+                  f"in-flight-expired {serve.get('expired_in_flight', 0)}) "
+                  f"by reason {serve.get('sheds_by_reason')}", file=out)
+        for d in serve.get("drains", ()):
+            print(f"drain      : {d['replica']} migrated={d['migrated']} "
+                  f"fallback_failovers={d['fallback_failovers']}",
+                  file=out)
+        if serve.get("hedges"):
+            print(f"hedges     : {serve['hedges']} placed, "
+                  f"{serve.get('hedge_wins', 0)} won", file=out)
     print(f"records    : {report['n_records']} surviving "
           f"({report['n_runs_started']} run(s) started, "
           f"{report['n_chunks_done']} chunk(s) completed)", file=out)

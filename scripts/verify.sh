@@ -43,6 +43,15 @@
 #                                      the host-sync lint over
 #                                      serving/fleet/'s traced slot
 #                                      movers)
+#        scripts/verify.sh --serve-slo (serve overload-control gate —
+#                                      deadline sheds at admission/queue/
+#                                      in-flight, criticality displacement,
+#                                      retry-budget arithmetic + parked
+#                                      failovers, hedging races, graceful
+#                                      drain token identity, and the
+#                                      3x-capacity storm soak's SLO
+#                                      asserts — plus the host-sync and
+#                                      lock-discipline lint over serving/)
 #        scripts/verify.sh --lint     (static analysis gate: the full
 #                                      dl4j-lint ruleset over the tree +
 #                                      the program-contract checks and
@@ -73,10 +82,10 @@
 #                                      invariance, contracts over the
 #                                      mixed program — plus the
 #                                      implicit-f32-promotion lint)
-# The eval/epoch/dp/heal/obs/serve/fleet/lint/profile/mfu tests are part
-# of the default tier-1 run; --eval/--epoch/--dp/--heal/--obs/--serve/
-# --fleet/--lint/--profile/--mfu are the narrow fast paths for iterating
-# on those surfaces.
+# The eval/epoch/dp/heal/obs/serve/fleet/serve-slo/lint/profile/mfu
+# tests are part of the default tier-1 run; --eval/--epoch/--dp/--heal/
+# --obs/--serve/--fleet/--serve-slo/--lint/--profile/--mfu are the
+# narrow fast paths for iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -122,6 +131,15 @@ elif [ "${1:-}" = "--fleet" ]; then
     # readback lives OUTSIDE them (export_slot), and the lint keeps any
     # new sync from riding into the compiled pool programs
     python scripts/dl4j_lint.py --select host-sync-in-hot-path \
+        deeplearning4j_tpu/serving || exit 1
+elif [ "${1:-}" = "--serve-slo" ]; then
+    shift
+    TARGET=tests/test_serve_overload.py
+    # overload control is control-plane code threaded around the traced
+    # decode programs: the shed/hedge/drain paths must add no host syncs
+    # to the hot roots and no unlocked cross-thread queue state
+    python scripts/dl4j_lint.py \
+        --select host-sync-in-hot-path,lock-discipline \
         deeplearning4j_tpu/serving || exit 1
 elif [ "${1:-}" = "--lint" ]; then
     shift

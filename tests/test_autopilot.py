@@ -473,20 +473,23 @@ class TestElasticReshard:
         monkeypatch.delenv("DL4J_STEP_DEADLINE_S")
         assert chunk_deadline_s(1, width_factor=8) == pytest.approx(240.0)
 
-    def test_wrapper_path_drops_request_with_warning(self, caplog):
-        """ParallelWrapper pins per-mesh programs: a reshard request on
-        its underlying net is logged and DROPPED, never applied
-        unsafely."""
+    def test_wrapper_path_applies_request(self):
+        """ParallelWrapper honors the reshard request at the chunk
+        boundary: its per-mesh epoch programs are dropped and re-pinned
+        on the new mesh (pre-fix, the wrapper path logged a warning and
+        DROPPED the request, training on the stale mesh)."""
         data = [_ff_data(16, seed=i) for i in range(2)]
         net = _ff_net()
         wrapper = ParallelWrapper(net, mesh=build_mesh())
         net.request_reshard(None)
-        with caplog.at_level(logging.WARNING,
-                             logger="deeplearning4j_tpu.perf.epoch_cache"):
-            wrapper.fit_epochs(ListDataSetIterator(list(data), 16), 2,
-                               chunk_epochs=1)
+        before = metrics().counter("elastic_reshards_total").value(
+            model="MultiLayerNetwork")
+        wrapper.fit_epochs(ListDataSetIterator(list(data), 16), 2,
+                           chunk_epochs=1)
         assert net._pending_mesh is None
-        assert any("reshard" in r.message for r in caplog.records)
+        assert metrics().counter("elastic_reshards_total").value(
+            model="MultiLayerNetwork") == before + 1
+        assert wrapper.mesh.shape["data"] == 1  # shrunk to one device
 
     def test_reshard_span_and_counter_on_timeline(self):
         data = [_ff_data(8, seed=i) for i in range(2)]

@@ -336,6 +336,37 @@ class TestVerifiedRecovery:
         assert ft.checkpoints() == [older, newer]
         assert ft.verify_checkpoint(newer) == "ok"
 
+    def test_torn_write_falls_back_to_previous_intact(self, tmp_path):
+        """A save that dies MID-WRITE (power cut after some bytes
+        landed, before the manifest): the torn archive sits at the
+        final path with no sidecar, and resume must fall back to the
+        previous intact candidate rather than load garbage or
+        fresh-start."""
+        from deeplearning4j_tpu.resilience import FaultInjected
+
+        ft, older, newer = _two_checkpoints(tmp_path)
+        net = ft.network
+        net.fit(toy())
+
+        def torn_write(site):
+            # model the torn write itself: partial bytes land at the
+            # final path, then the crash — no manifest is ever written
+            with open(ft._ckpt_path(net.iteration_count), "wb") as f:
+                f.write(b"PK\x03\x04 torn mid-write")
+            raise FaultInjected(f"injected torn write at {site}")
+
+        with inject("checkpoint.save", torn_write):
+            with pytest.raises(FaultInjected):
+                ft.save()
+        torn = ft._ckpt_path(net.iteration_count)
+        assert os.path.exists(torn)
+        assert not os.path.exists(torn + ".sha256")
+
+        net2 = make_net(seed=99)
+        ft2 = FaultTolerantTrainer(net2, ft.dir)
+        assert ft2.resume() is True
+        assert net2.iteration_count == 8  # newest INTACT candidate
+
 
 # ---------------------------------------------------------------------------
 # chaos: initialize_distributed retry path

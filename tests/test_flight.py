@@ -565,6 +565,38 @@ class TestEndStateClassification:
         ]
         assert classify_end_state(records)["end_state"] == "crashed"
 
+    def test_drain_evidence_classifies_drained(self):
+        """An orderly close whose timeline carries ``serve.drain``
+        evidence reads as a planned retirement — and outranks any
+        sheds the same storm produced (the shed count stays in the
+        evidence)."""
+        t = 1000.0
+        records = [
+            {"kind": "run.start", "t_wall": t},
+            {"kind": "serve.shed", "t_wall": t + 0.5, "where": "queue",
+             "reason": "deadline", "criticality": "batch"},
+            {"kind": "serve.drain", "t_wall": t + 1, "replica": "r1",
+             "migrated": 3, "fallback_failovers": 0},
+            {"kind": "run.end", "t_wall": t + 2, "status": "clean"},
+            {"kind": "flight.close", "t_wall": t + 3},
+        ]
+        out = classify_end_state(records)
+        assert out["end_state"] == "drained"
+        assert out["evidence"]["n_drains"] == 1
+        assert out["evidence"]["n_sheds"] == 1
+
+    def test_shed_evidence_classifies_shed_overload(self):
+        records = [
+            {"kind": "run.start", "t_wall": 1000.0},
+            {"kind": "serve.shed", "t_wall": 1001.0, "where": "queue",
+             "reason": "deadline", "criticality": "best_effort"},
+            {"kind": "run.end", "t_wall": 1002.0, "status": "clean"},
+            {"kind": "flight.close", "t_wall": 1003.0},
+        ]
+        out = classify_end_state(records)
+        assert out["end_state"] == "shed-overload"
+        assert out["evidence"]["n_sheds"] == 1
+
     def test_no_records(self):
         assert classify_end_state([])["end_state"] == "unknown"
 

@@ -87,6 +87,44 @@ class TestParallelWrapper:
         wrapper.fit(ListDataSetIterator(ds, batch_size=64), num_epochs=20)
         assert net.evaluate(ds).accuracy() > 0.9
 
+    def test_wrapper_elastic_reshard_matches_uninterrupted(self):
+        """``wrapper.request_reshard`` is honored at the next chunk
+        boundary (shrink to one device, then grow back): the wrapper
+        re-pins its per-mesh epoch programs, the reshard counter proves
+        the request was applied rather than dropped, and final params
+        match the uninterrupted run."""
+        from deeplearning4j_tpu.monitor import metrics
+
+        data = [toy(n=64, seed=i) for i in range(4)]
+        base_net = mlp()
+        base = ParallelWrapper(base_net, mesh=build_mesh())
+        base.fit_epochs(ListDataSetIterator(list(data), 64), 6,
+                        chunk_epochs=2)
+
+        net = mlp()
+        wrapper = ParallelWrapper(net, mesh=build_mesh())
+        seen = {"n": 0}
+
+        def on_chunk(done):
+            seen["n"] += 1
+            if seen["n"] == 1:
+                wrapper.request_reshard(None)         # shrink: 8 -> 1
+            elif seen["n"] == 2:
+                wrapper.request_reshard(build_mesh())  # grow: 1 -> 8
+            return False
+
+        before = metrics().counter("elastic_reshards_total").value(
+            model="MultiLayerNetwork")
+        wrapper.fit_epochs(ListDataSetIterator(list(data), 64), 6,
+                           chunk_epochs=2, on_chunk=on_chunk)
+        assert metrics().counter("elastic_reshards_total").value(
+            model="MultiLayerNetwork") == before + 2
+        assert net._pending_mesh is None
+        assert wrapper.mesh.shape["data"] == 8
+        np.testing.assert_allclose(
+            base_net.get_flat_params(), net.get_flat_params(),
+            rtol=2e-4, atol=1e-5)
+
     def test_indivisible_batch_falls_back_unsharded(self):
         """A ragged batch (e.g. a CSV's final partial batch) trains via the
         network's own unsharded step instead of crashing mid-epoch."""

@@ -32,6 +32,9 @@ gap quantifies the host-dispatch floor (~4 ms/dispatch on this tunnel).
     (ParallelWrapper.fit_epochs) — weak-scaling samples/sec/chip +
     dispatches-per-epoch (must stay 1 at any device count); skipped
     when only one device is visible
+  - mesh_sweep: DP×TP grid under the sharding registry — step time,
+    dispatches/chunk (must stay 1 over BOTH axes) and the per-chip
+    HBM model per mesh shape; skipped below 4 devices
   - guard: numeric-sentinel overhead (on vs off, <3% target) + async
     checkpoint blocking time
   - telemetry: in-program metrics-pack overhead (on vs off, <3%
@@ -594,6 +597,97 @@ def bench_dp_epoch():
             "dispatches_per_epoch": round(dpe, 2),
             "cache_n_shard": cache.n_shard,
             "cache_mb_total": round(cache.nbytes / 1024 ** 2, 2)}
+
+
+def bench_mesh_sweep():
+    """DP×TP grid under the sharding registry: the SAME fused epoch
+    program launched over each mesh shape. Per shape: dispatches/chunk
+    (must stay 1 — the registry composes the axes into ONE GSPMD
+    program), steady-state step time, and the per-chip HBM model
+    (params + updater state actually resident on the fullest device +
+    the cache's per-shard slice). The most-TP shape's step time and
+    per-chip HBM are the TRACKED series: TP must shrink per-chip weights
+    without breaking whole-epoch fusion. Embeds registry.describe() for
+    the record."""
+    import jax
+
+    n = len(jax.devices())
+    if n < 4:
+        return {"skipped": f"only {n} devices visible; mesh_sweep "
+                           "needs >= 4", "devices": n}
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models import mnist_mlp
+    from deeplearning4j_tpu.parallel import build_mesh
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec
+
+    rng = np.random.default_rng(0)
+    per_chip, n_batches, epochs = 128, 8, 4
+    batch = per_chip * n
+    total = batch * n_batches
+    ds = DataSet(rng.random((total, 784), np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, total)])
+
+    def per_device_mb(trees):
+        # bytes on the FULLEST device — replicated leaves count fully
+        # on every device, sharded leaves only their local slice
+        per = {}
+        for tree in trees:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                for s in getattr(leaf, "addressable_shards", ()):
+                    per[s.device.id] = (per.get(s.device.id, 0)
+                                        + s.data.nbytes)
+        return max(per.values(), default=0) / 1024 ** 2
+
+    shapes = [(n, 1), (n // 2, 2)]
+    if n % 4 == 0:
+        shapes.append((n // 4, 4))
+    grid, describe = [], None
+    for dp, tp in shapes:
+        net = mnist_mlp(hidden=512).init()
+        mesh = build_mesh(MeshSpec(data=dp, model=tp))
+        cache = net.build_epoch_cache(
+            ListDataSetIterator(ds, batch), mesh=mesh)
+        if cache is None:
+            grid.append({"mesh": f"{dp}x{tp}",
+                         "error": "cache over budget"})
+            continue
+        t0 = time.perf_counter()
+        net.fit_epochs(cache, 1, chunk_epochs=1)  # compile + warm
+        _sync(net.params)
+        compile_s = time.perf_counter() - t0
+        d0 = net._train_dispatches
+        t0 = time.perf_counter()
+        net.fit_epochs(cache, epochs, chunk_epochs=1)
+        _sync(net.params)
+        sec = time.perf_counter() - t0
+        dpc = (net._train_dispatches - d0) / epochs
+        row = {"mesh": f"{dp}x{tp}", "dp": dp, "tp": tp,
+               "dispatches_per_chunk": round(dpc, 2),
+               "compile_s": round(compile_s, 3),
+               "step_ms": round(sec / (epochs * n_batches) * 1e3, 3),
+               "samples_per_sec": round(total * epochs / sec, 1),
+               "per_chip_weights_mb": round(
+                   per_device_mb([net.params, net.updater_state]), 3),
+               "per_chip_hbm_mb": round(
+                   per_device_mb([net.params, net.updater_state])
+                   + cache.nbytes / max(1, cache.n_shard) / 1024 ** 2, 3)}
+        grid.append(row)
+        describe = net._sharding_registry.describe()
+        _log(f"mesh_sweep {row['mesh']}: {row['step_ms']} ms/step, "
+             f"{row['dispatches_per_chunk']} dispatches/chunk, "
+             f"{row['per_chip_hbm_mb']} MB/chip")
+    good = [r for r in grid if "error" not in r]
+    if not good:
+        return {"devices": n, "grid": grid,
+                "error": "no mesh shape fit the cache budget"}
+    tp_row = max(good, key=lambda r: r["tp"])
+    return {"devices": n, "grid": grid,
+            "tp_mesh": tp_row["mesh"],
+            "tp_step_ms": tp_row["step_ms"],
+            "tp_dispatches_per_chunk": tp_row["dispatches_per_chunk"],
+            "tp_per_chip_hbm_mb": tp_row["per_chip_hbm_mb"],
+            "registry": describe}
 
 
 def bench_guard():
@@ -1704,6 +1798,7 @@ def main() -> None:
                 ("eval", bench_eval),
                 ("epoch", bench_epoch),
                 ("dp_epoch", bench_dp_epoch),
+                ("mesh_sweep", bench_mesh_sweep),
                 ("serve", bench_serve),
                 ("serve_fleet", bench_serve_fleet),
                 ("guard", bench_guard),

@@ -333,7 +333,8 @@ def check_network_contracts(net, cache, *, epochs: int = 2,
                             allowed_axes: Optional[Sequence[str]] = None,
                             expect_donation: bool = True,
                             raise_on_violation: bool = True,
-                            require_programs: bool = True
+                            require_programs: bool = True,
+                            registry=None
                             ) -> Dict[Tuple, List[str]]:
     """Contract-check EVERY cached fused program on ``net`` (a network or
     a ``ParallelWrapper`` — the wrapper's SPMD programs cache on the
@@ -342,7 +343,15 @@ def check_network_contracts(net, cache, *, epochs: int = 2,
     listing every violation unless ``raise_on_violation=False``. An empty
     or missing ``_epoch_steps`` cache raises :class:`ValueError` unless
     ``require_programs=False`` — a vacuous pass must never look like a
-    checked one."""
+    checked one.
+
+    The declared-axes set for check 3 resolves, in order: explicit
+    ``allowed_axes=``; ``registry=`` (a ``ShardingRegistry``); the
+    registry the last registry-driven placement stamped on the network
+    (``net._sharding_registry`` — TP/PP programs may then ONLY reduce
+    over axes the registry actually mapped something to, a strictly
+    tighter set than the mesh's axis names); finally every axis of the
+    net/cache mesh."""
     network = getattr(net, "network", net)
     programs = getattr(net, "_epoch_steps", None) or {}
     if not programs and require_programs:
@@ -352,8 +361,15 @@ def check_network_contracts(net, cache, *, epochs: int = 2,
             "require_programs=False to accept an empty check"
             % type(net).__name__)
     if allowed_axes is None:
-        mesh = getattr(net, "mesh", None) or getattr(cache, "mesh", None)
-        allowed_axes = tuple(mesh.axis_names) if mesh is not None else ()
+        if registry is None:
+            registry = (getattr(net, "_registry", None)
+                        or getattr(network, "_sharding_registry", None))
+        if registry is not None:
+            allowed_axes = tuple(sorted(registry.declared_axes))
+        else:
+            mesh = (getattr(net, "mesh", None)
+                    or getattr(cache, "mesh", None))
+            allowed_axes = tuple(mesh.axis_names) if mesh is not None else ()
     specs = fused_program_specs(network, cache, epochs) if programs else None
     results: Dict[Tuple, List[str]] = {}
     for key, fn in sorted(programs.items(), key=repr):

@@ -1232,6 +1232,46 @@ class MarkerAuditRule(Rule):
         return marks
 
 
+# ---------------------------------------------------------------------------
+# ad-hoc out_shardings / NamedSharding construction
+# ---------------------------------------------------------------------------
+
+REGISTRY_MODULE = "deeplearning4j_tpu/parallel/sharding_registry.py"
+
+
+class AdhocOutShardingsRule(Rule):
+    id = "adhoc-out-shardings"
+    doc = ("NamedSharding constructed / out_shardings= passed outside "
+           "parallel/sharding_registry.py — placement decisions belong "
+           "in the per-model sharding registry (one mesh, one spec per "
+           "leaf); sanctioned low-level builders carry per-site "
+           "suppressions with reasons")
+
+    def check(self, module: Module, config: LintConfig) -> List[Finding]:
+        if module.rel == REGISTRY_MODULE:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d == "NamedSharding" or d.endswith(".NamedSharding"):
+                self.emit(
+                    out, module, node,
+                    "ad-hoc NamedSharding construction — route placement "
+                    "through parallel/sharding_registry (named()/"
+                    "ShardingRegistry) or suppress with a reason")
+            for kw in node.keywords:
+                if kw.arg == "out_shardings":
+                    self.emit(
+                        out, module, node,
+                        "ad-hoc out_shardings= pin — source the shardings "
+                        "from the model's ShardingRegistry "
+                        "(epoch_out_shardings/param_shardings) or "
+                        "suppress with a reason")
+        return out
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncRule(),
     ImplicitF32PromotionRule(),
@@ -1241,4 +1281,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     DonationConsistencyRule(),
     BareCounterRule(),
     MarkerAuditRule(),
+    AdhocOutShardingsRule(),
 )

@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu import dtypes as dtypes_mod
 from deeplearning4j_tpu.analysis.annotations import traced
@@ -782,12 +782,14 @@ class TransformerLM:
 
         PartitionSpec is a tuple subclass, so tree_map would descend into it;
         flatten the params treedef and match specs leaf-for-leaf instead."""
+        from deeplearning4j_tpu.parallel.sharding_registry import named
+
         specs = specs or self.param_specs(
             model_axis_size=dict(mesh.shape).get(MODEL_AXIS, 1))
         flat_p, treedef = jax.tree_util.tree_flatten(self.params)
         flat_spec = treedef.flatten_up_to(specs)
         self.params = jax.tree_util.tree_unflatten(treedef, [
-            jax.device_put(p, NamedSharding(mesh, s))
+            jax.device_put(p, named(mesh, s))
             for p, s in zip(flat_p, flat_spec)
         ])
         flat_s, sdef = jax.tree_util.tree_flatten(self.opt_state)
@@ -795,6 +797,6 @@ class TransformerLM:
         # param spec twice in flatten order (dict keys sort: m, v)
         flat_sspec = [s for s in flat_spec for _ in range(2)]
         self.opt_state = jax.tree_util.tree_unflatten(sdef, [
-            jax.device_put(p, NamedSharding(mesh, s))
+            jax.device_put(p, named(mesh, s))
             for p, s in zip(flat_s, flat_sspec)
         ])

@@ -728,12 +728,23 @@ class ComputationGraph:
     def _place_replicated(self, mesh):
         """Replicate params/updater/net state on ``mesh`` (see
         MultiLayerNetwork._place_replicated)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            replicated_sharding)
 
-        repl = NamedSharding(mesh, P())
+        repl = replicated_sharding(mesh)
         self.params = jax.device_put(self.params, repl)
         self.updater_state = jax.device_put(self.updater_state, repl)
         self.net_state = jax.device_put(self.net_state, repl)
+
+    def _place_on_mesh(self, mesh):
+        """Registry-driven placement: replicate on pure-DP meshes, shard
+        tensor-parallel when the mesh has a ``model`` axis (vertex specs
+        follow topological order so the Megatron column/row alternation
+        tracks dataflow — see MultiLayerNetwork._place_on_mesh)."""
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            ShardingRegistry)
+
+        return ShardingRegistry.for_network(self, mesh).place_network(self)
 
     def request_reshard(self, mesh) -> None:
         """Request a chunk-boundary elastic reshard of the in-flight
@@ -786,13 +797,15 @@ class ComputationGraph:
             return None
         accum = effective_accum_steps(accum_steps, cache.batch)
         if cache.mesh is not None:
-            self._place_replicated(cache.mesh)
+            self._place_on_mesh(cache.mesh)
         guard = nan_guard_policy() if guard is None else guard
         guarded = guard != "off"
         stride = fused_metrics_stride(telemetry)
-        step = self._epoch_train_step(shuffle, accum, guarded, stride)
 
         def launch(epoch_keys):
+            # resolved per launch: a topology reshard clears the program
+            # cache (see MultiLayerNetwork.fit_epochs)
+            step = self._epoch_train_step(shuffle, accum, guarded, stride)
             out = step(
                 self.params, self.updater_state, self.net_state,
                 jnp.asarray(self.iteration_count, jnp.int32),

@@ -715,12 +715,26 @@ class MultiLayerNetwork:
         """Replicate params/updater/net state on ``mesh`` so a sharded
         dataset cache and the trainable state agree on device placement
         (GSPMD then inserts the per-step gradient all-reduce)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            replicated_sharding)
 
-        repl = NamedSharding(mesh, P())
+        repl = replicated_sharding(mesh)
         self.params = jax.device_put(self.params, repl)
         self.updater_state = jax.device_put(self.updater_state, repl)
         self.net_state = jax.device_put(self.net_state, repl)
+
+    def _place_on_mesh(self, mesh):
+        """Place trainable state on ``mesh`` via the sharding registry:
+        pure-DP meshes replicate every leaf (GSPMD inserts the gradient
+        all-reduce); meshes with a ``model`` axis shard params/updater
+        state tensor-parallel per the registry's Megatron layer rules —
+        the SAME fused epoch program then runs DP×TP with GSPMD
+        propagating the shardings (no out_shardings pinning, so elastic
+        reshard to a different topology stays valid)."""
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            ShardingRegistry)
+
+        return ShardingRegistry.for_network(self, mesh).place_network(self)
 
     def request_reshard(self, mesh) -> None:
         """Request a mid-run elastic reshard of the in-flight
@@ -819,13 +833,17 @@ class MultiLayerNetwork:
             return None
         accum = effective_accum_steps(accum_steps, cache.batch)
         if cache.mesh is not None:
-            self._place_replicated(cache.mesh)
+            self._place_on_mesh(cache.mesh)
         guard = nan_guard_policy() if guard is None else guard
         guarded = guard != "off"
         stride = fused_metrics_stride(telemetry)
-        step = self._epoch_train_step(shuffle, accum, guarded, stride)
 
         def launch(epoch_keys):
+            # resolved per launch: an elastic TOPOLOGY reshard clears the
+            # program cache (the flat-vs-per-layer updater-apply choice is
+            # baked in at trace time from the live placements, so a stale
+            # trace would miscompile under the new shardings)
+            step = self._epoch_train_step(shuffle, accum, guarded, stride)
             out = step(
                 self.params, self.updater_state, self.net_state,
                 jnp.asarray(self.iteration_count, jnp.int32),

@@ -535,7 +535,7 @@ class FaultTolerantTrainer:
                 f"from scratch (newest: {saw_corrupt[0]})")
         return False
 
-    def _reshard(self, mesh, fsdp: bool) -> None:
+    def _reshard(self, mesh, fsdp: bool) -> None:  # dl4j-lint: disable=adhoc-out-shardings -- restore-path placement on a freshly restored model; mirrors registry replicated layout
         """Place the restored state on ``mesh``: replicated (the layout
         the fused SPMD programs pin) or FSDP-sharded over ``data``."""
         import jax

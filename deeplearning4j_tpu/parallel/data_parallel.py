@@ -74,20 +74,23 @@ class ParallelWrapper:
         return self.mesh.shape[DATA_AXIS]
 
     def _place_params(self):
-        """Replicate (or FSDP-shard) params/updater/net state."""
-        repl = NamedSharding(self.mesh, P())
-        net = self.network
-        if self.fsdp:
-            from deeplearning4j_tpu.parallel.fsdp import shard_tree
+        """Registry-driven placement: the sharding registry derives every
+        leaf's spec from the mesh (replicated on pure-DP meshes, Megatron
+        TP where the mesh has a ``model`` axis), composed with FSDP over
+        ``data`` via ``with_fsdp`` when ``fsdp=True``. The derived
+        param/updater shardings are kept for the epoch program's
+        out_shardings pin."""
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            ShardingRegistry)
 
-            net.params, self._param_shardings = shard_tree(
-                net.params, self.mesh, with_shardings=True)
-            net.updater_state, self._upd_shardings = shard_tree(
-                net.updater_state, self.mesh, with_shardings=True)
-        else:
-            net.params = jax.device_put(net.params, repl)
-            net.updater_state = jax.device_put(net.updater_state, repl)
-        net.net_state = jax.device_put(net.net_state, repl)
+        net = self.network
+        reg = ShardingRegistry.for_network(net, self.mesh)
+        if self.fsdp:
+            reg = reg.with_fsdp(net.params)
+        self._registry = reg
+        self._param_shardings = reg.param_shardings(net.params)
+        self._upd_shardings = reg.state_shardings(net.updater_state)
+        reg.place_network(net)
 
     def request_reshard(self, mesh) -> None:
         """Request a mid-run elastic reshard of an in-flight
@@ -115,9 +118,10 @@ class ParallelWrapper:
         cache.respec(self.mesh)
 
     @functools.cached_property
-    def _fsdp_train_step(self):
+    def _fsdp_train_step(self):  # dl4j-lint: disable=adhoc-out-shardings -- shardings sourced from the registry (with_fsdp); only the jit pin lives here
         """The network's step re-jitted with out_shardings pinned to the
-        FSDP specs so donated updates keep state sharded across steps."""
+        registry's FSDP specs so donated updates keep state sharded
+        across steps."""
         return jax.jit(
             self.network._step_impl,
             donate_argnums=(0, 1, 2) if self._donate else (),
@@ -125,10 +129,13 @@ class ParallelWrapper:
                            None, None, None))
 
     def _shard_batch(self, arr):
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            batch_sharding)
+
         if arr is None:
             return None
-        spec = P(DATA_AXIS, *([None] * (np.ndim(arr) - 1)))
-        return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
+        return jax.device_put(
+            jnp.asarray(arr), batch_sharding(self.mesh, np.ndim(arr)))
 
     def fit(self, data, num_epochs: int = 1):
         """fit(DataSetIterator | DataSet). Batches are sharded over 'data';
@@ -248,27 +255,26 @@ class ParallelWrapper:
         return self.network.build_epoch_cache(
             data, mesh=self.mesh, accum_steps=accum_steps)
 
-    def _epoch_program(self, shuffle: bool, accum_steps: int,
+    def _epoch_program(self, shuffle: bool, accum_steps: int,  # dl4j-lint: disable=adhoc-out-shardings -- shardings sourced from the registry; only the jit pin lives here
                        guard: bool = False, metrics_stride: int = 0):
         """The network's pure chunk program jitted for SPMD execution:
-        out_shardings pinned so donated params/updater state STAY
-        replicated (or FSDP-sharded) across chunks instead of whatever
-        the partitioner would pick. With the numeric sentinel compiled in
-        (``guard``) the program returns an extra output — the ``[E, N]``
-        trip history — replicated like the loss history; the telemetry
-        metrics pack (``metrics_stride``) appends another replicated
-        ``[E, N, 4]`` output after it."""
+        out_shardings pinned to the registry's per-leaf specs so donated
+        params/updater state STAY in their registry layout (replicated,
+        TP-sharded, FSDP-sharded, or a composition) across chunks instead
+        of whatever the partitioner would pick. With the numeric sentinel
+        compiled in (``guard``) the program returns an extra output — the
+        ``[E, N]`` trip history — replicated like the loss history; the
+        telemetry metrics pack (``metrics_stride``) appends another
+        replicated ``[E, N, 4]`` output after it."""
         from deeplearning4j_tpu.monitor.profile import ProfiledProgram
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            replicated_sharding)
 
         key = (shuffle, accum_steps, guard, metrics_stride)
         fn = self._epoch_steps.get(key)
         if fn is None:
-            repl = NamedSharding(self.mesh, P())
-            if self.fsdp:
-                out = (self._param_shardings, self._upd_shardings,
-                       repl, repl)
-            else:
-                out = (repl, repl, repl, repl)
+            repl = replicated_sharding(self.mesh)
+            out = (self._param_shardings, self._upd_shardings, repl, repl)
             if guard:
                 out = out + (repl,)
             if metrics_stride:
@@ -511,7 +517,7 @@ class ParameterAveragingTrainer:
         self._local_steps = 0
 
     # ------------------------------------------------------------------
-    def _stack(self, tree):
+    def _stack(self, tree):  # dl4j-lint: disable=adhoc-out-shardings -- replica-axis stacking is local-SGD semantics, not model placement; registry axes do not apply
         r = self.num_replicas
         stacked = jax.tree_util.tree_map(
             lambda p: jnp.broadcast_to(p[None], (r,) + p.shape), tree)

@@ -57,7 +57,7 @@ def init_moe_params(cfg: MoEConfig, key) -> Dict[str, jnp.ndarray]:
     }
 
 
-def shard_moe_params(params, mesh: Mesh, axis_name: str = EXPERT_AXIS):
+def shard_moe_params(params, mesh: Mesh, axis_name: str = EXPERT_AXIS):  # dl4j-lint: disable=adhoc-out-shardings -- sanctioned expert-axis placement builder; registry covers data/model/pipe
     """Shard the stacked expert weights over the expert axis; router is
     replicated (every device routes its own tokens)."""
     from deeplearning4j_tpu.parallel.mesh import shard_leading_axis
@@ -110,7 +110,7 @@ def _top_k_dispatch(gates: jnp.ndarray, capacity: int, top_k: int):
     return dispatch, combine, aux
 
 
-def moe_ffn(
+def moe_ffn(  # dl4j-lint: disable=adhoc-out-shardings -- in-program expert-axis constraints; the registry scopes data/model/pipe placement
     params: Dict[str, jnp.ndarray],
     x: jnp.ndarray,
     cfg: MoEConfig,

@@ -49,7 +49,7 @@ def fsdp_spec(shape: Tuple[int, ...], mesh: Mesh,
     return P(*entries)
 
 
-def fsdp_shardings(tree: Any, mesh: Mesh, axis: str = DATA_AXIS) -> Any:
+def fsdp_shardings(tree: Any, mesh: Mesh, axis: str = DATA_AXIS) -> Any:  # dl4j-lint: disable=adhoc-out-shardings -- sanctioned FSDP spec builder; the registry composes fsdp_spec via with_fsdp
     """Per-leaf NamedShardings for an arbitrary pytree (optimizer-state
     leaves mirror their parameter's shape, so the same rule applies)."""
     return jax.tree_util.tree_map(
@@ -108,7 +108,7 @@ class FSDP:
                 "class docstring)")
         return val
 
-    def jit_step(self, step_fn: Callable, *, donate: bool = True,
+    def jit_step(self, step_fn: Callable, *, donate: bool = True,  # dl4j-lint: disable=adhoc-out-shardings -- pins the FSDP specs this wrapper owns; registry-era callers pass registry shardings
                  aux_sharding: Optional[Any] = None) -> Callable:
         """Jit ``step_fn(params, opt_state, *args) -> (params, opt_state,
         aux)`` with out_shardings pinned to the FSDP specs. ``aux`` is

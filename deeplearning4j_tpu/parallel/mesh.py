@@ -97,17 +97,17 @@ def build_mesh(
     return Mesh(dev_array, names)
 
 
-def replicated(mesh: Mesh) -> NamedSharding:
+def replicated(mesh: Mesh) -> NamedSharding:  # dl4j-lint: disable=adhoc-out-shardings -- mesh-level primitive the sharding registry composes
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 2,
+def batch_sharding(mesh: Mesh, ndim: int = 2,  # dl4j-lint: disable=adhoc-out-shardings -- mesh-level primitive the sharding registry composes
                    axis: str = DATA_AXIS) -> NamedSharding:
     """Shard axis 0 (batch) over ``axis``; replicate the rest."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
-def shard_leading_axis(tree, mesh: Mesh, axis_name: str):
+def shard_leading_axis(tree, mesh: Mesh, axis_name: str):  # dl4j-lint: disable=adhoc-out-shardings -- mesh-level primitive the sharding registry composes (stage_spec)
     """device_put every leaf with its leading dim sharded over ``axis_name``
     (replicated everywhere else). When the axis was dropped from the mesh
     (size 1), leaves are fully replicated."""

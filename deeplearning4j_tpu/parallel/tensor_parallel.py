@@ -40,10 +40,18 @@ def _lstm_spec() -> Dict[str, P]:
 
 def param_specs_for_network(conf) -> Dict[str, Any]:
     """PartitionSpec tree matching a MultiLayerConfiguration's param tree."""
+    return param_specs_for_layers(
+        (str(i), lc) for i, lc in enumerate(conf.layers))
+
+
+def param_specs_for_layers(items) -> Dict[str, Any]:
+    """The Megatron layer rules over any keyed layer-conf sequence —
+    MultiLayerNetwork passes indexed layers, the sharding registry passes
+    a ComputationGraph's named layers in topological order (so the
+    column/row dense alternation follows dataflow)."""
     specs: Dict[str, Any] = {}
     dense_count = 0
-    for i, lc in enumerate(conf.layers):
-        si = str(i)
+    for si, lc in items:
         if isinstance(lc, (L.DenseLayer, L.OutputLayer, L.AutoEncoder)):
             # Output layers stay replicated: their n_out is the class count,
             # usually tiny and followed by a softmax over the full axis.
@@ -82,7 +90,7 @@ class _ReplicateAll:
     """Sentinel: replicate every leaf of this layer's params."""
 
 
-def shard_network_params(network, mesh: Mesh,
+def shard_network_params(network, mesh: Mesh,  # dl4j-lint: disable=adhoc-out-shardings -- sanctioned legacy TP placement builder; the sharding registry (for_network) is the registry-era path
                          specs: Optional[Dict[str, Any]] = None) -> None:
     """device_put the network's params (and mirrored updater state) with
     tensor-parallel NamedShardings. The subsequent jitted train step is then

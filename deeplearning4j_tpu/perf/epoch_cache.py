@@ -140,14 +140,11 @@ def _data_shards(mesh) -> int:
 def _batch_sharding(mesh, ndim: int):
     """NamedSharding for an ``[N, B, ...]`` stack: N replicated, B sharded
     over ``data``, trailing dims replicated."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+    from deeplearning4j_tpu.parallel.sharding_registry import batch_sharding
 
     if mesh is None:
         return None
-    return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+    return batch_sharding(mesh, ndim, stacked=True)
 
 
 def _place(arr, mesh, sharded: bool = True):
@@ -162,9 +159,10 @@ def _place(arr, mesh, sharded: bool = True):
     if mesh is None:
         return jax.device_put(arr)
     if not sharded:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            replicated_sharding)
 
-        return jax.device_put(arr, NamedSharding(mesh, P()))
+        return jax.device_put(arr, replicated_sharding(mesh))
     return jax.device_put(arr, _batch_sharding(mesh, arr.ndim))
 
 
@@ -551,8 +549,13 @@ def elastic_reshard(net, cache, mesh) -> None:
     re-sharding contract, minus the checkpoint round trip: the trainable
     state (params / updater state / net state) snapshots to FULL host
     tensors (GSPMD's sharding is a layout, not a format — a full tensor
-    lands on any topology), re-places replicated on the new mesh, and
-    the dataset cache ``respec``s its stacks onto the new ``data`` axis.
+    lands on any topology), re-places via the sharding registry on the
+    new mesh, and the dataset cache ``respec``s its stacks onto the new
+    ``data`` axis. Because the snapshot is topology-free and the
+    registry re-derives specs from the NEW mesh, this handles *topology*
+    changes, not just width changes: 8x1 -> 4x2 re-shards TP leaves over
+    the new ``model`` axis (the collective-redistribution formulation of
+    arXiv 2112.01075, realized as gather-to-host + registry re-place).
     Everything else — the epoch RNG key chain, the iteration count, the
     LR scale, the chunk cursor — is host state the driver carries and is
     untouched, so the continued run consumes the identical key stream
@@ -573,7 +576,18 @@ def elastic_reshard(net, cache, mesh) -> None:
         net.net_state = jax.device_put(nst)
     else:
         net.params, net.updater_state, net.net_state = params, upd, nst
-        net._place_replicated(mesh)
+        if hasattr(net, "_place_on_mesh"):
+            net._place_on_mesh(mesh)
+        else:
+            net._place_replicated(mesh)
+    # drop cached fused programs: the flat-vs-per-layer updater-apply
+    # choice is baked in at TRACE time from the live placements, and a
+    # topology change (e.g. 8x1 -> 4x2) can flip it — a stale trace
+    # would miscompile under the new shardings (the wrapper's
+    # _apply_reshard already does this for its own program cache)
+    steps = getattr(net, "_epoch_steps", None)
+    if steps is not None:
+        steps.clear()
     cache.respec(mesh)
 
 

@@ -483,7 +483,7 @@ class DecodeEngine:
                  buckets: Optional[Sequence[int]] = None,
                  kv_dtype: Optional[str] = None,
                  draft_model=None, draft_layers: int = 0,
-                 spec_tokens: int = 3):
+                 spec_tokens: int = 3, mesh=None):
         if temperature < 0.0:
             raise ValueError(f"temperature={temperature} must be >= 0")
         if top_k is not None and not 1 <= top_k <= model.vocab_size:
@@ -491,7 +491,22 @@ class DecodeEngine:
                 f"top_k={top_k} must be in [1, vocab={model.vocab_size}]")
         model._ensure_init()
         self.model = model
-        self.cache = SlotKVCache(model, slots, max_len, kv_dtype)
+        # ``mesh=`` serves tensor-parallel: the model's sharding registry
+        # (the SAME Megatron specs training uses) places the params over
+        # ``model`` and the slot pool shards its head axis to match —
+        # decode/prefill programs are partitioned by GSPMD from the input
+        # shardings, so a model bigger than one chip's HBM serves on a
+        # TP slice with token-identical greedy streams.
+        self.mesh = mesh
+        self.registry = None
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding_registry import (
+                ShardingRegistry)
+
+            self.registry = ShardingRegistry.for_transformer(model, mesh)
+            model.params = self.registry.place(model.params)
+        self.cache = SlotKVCache(model, slots, max_len, kv_dtype,
+                                 registry=self.registry)
         self.slots = self.cache.slots
         self.max_len = self.cache.max_len
         self.kv_dtype = self.cache.kv_dtype
@@ -526,11 +541,24 @@ class DecodeEngine:
             self.draft_model = draft_model
         self.draft_cache = None
         if self.draft_model is not None:
+            draft_reg = self.registry
+            if self.registry is not None and draft_model is not None:
+                # independent draft: its own registry (own layer count /
+                # head split); the shallow self-draft shares the target's
+                # already-placed buffers, so the target registry applies
+                from deeplearning4j_tpu.parallel.sharding_registry import (
+                    ShardingRegistry)
+
+                draft_reg = ShardingRegistry.for_transformer(
+                    self.draft_model, self.mesh)
+                self.draft_model.params = draft_reg.place(
+                    self.draft_model.params)
             # same slot count/positions as the target pool (the
             # SlotKVCache ctor re-validates learned-table capacity for
             # the draft's own position table)
             self.draft_cache = SlotKVCache(
-                self.draft_model, self.slots, self.max_len, kv_dtype)
+                self.draft_model, self.slots, self.max_len, kv_dtype,
+                registry=draft_reg)
         # the fleet story: point jax's persistent compilation cache at
         # DL4J_COMPILE_CACHE_DIR before this engine's first compile
         ensure_compile_cache()

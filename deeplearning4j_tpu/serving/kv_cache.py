@@ -182,7 +182,11 @@ class SlotKVCache:
     n_shard = 1
 
     def __init__(self, model, slots: int, max_len: Optional[int] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None, registry=None):
+        """``registry=`` (a ``ShardingRegistry``) shards the pool over the
+        mesh ``model`` axis with the SAME head split the attention params
+        use — each TP shard holds ``Hkv/tp`` heads of every slot, so the
+        pool budget (``nbytes / n_shard``) becomes per-shard."""
         import jax.numpy as jnp
 
         if slots < 1:
@@ -215,6 +219,29 @@ class SlotKVCache:
         # advances it in-program; the host only writes it at fusion
         # boundaries and never reads it back.
         self.cursors = jnp.zeros(self.slots, jnp.int32)
+        self.registry = registry
+        if registry is not None:
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            from deeplearning4j_tpu.parallel.sharding_registry import (
+                model_axis_size, named, replicated_sharding)
+
+            pool_spec = registry.kv_pool_spec(model.num_kv_heads)
+            pool = named(registry.mesh, pool_spec)
+            self.k = jax.device_put(self.k, pool)
+            self.v = jax.device_put(self.v, pool)
+            if self.k_scale is not None:
+                sc = named(registry.mesh,
+                           registry.kv_scale_spec(model.num_kv_heads))
+                self.k_scale = jax.device_put(self.k_scale, sc)
+                self.v_scale = jax.device_put(self.v_scale, sc)
+            self.cursors = jax.device_put(
+                self.cursors, replicated_sharding(registry.mesh))
+            if pool_spec != P():
+                # instance attr shadows the class default 1:
+                # validate_cache_budget prices nbytes/n_shard per device
+                self.n_shard = model_axis_size(registry.mesh)
 
     @property
     def quantized(self) -> bool:

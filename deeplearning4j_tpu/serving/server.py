@@ -71,12 +71,17 @@ class DecodeServer:
                  fuse_steps: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
                  draft_model=None, draft_layers: Optional[int] = None,
-                 spec_tokens: int = 3,
+                 spec_tokens: int = 3, mesh=None,
                  clock=time.monotonic):
         self.fuse_steps = (fuse_steps if fuse_steps is not None
                            else serve_fuse_steps())
         if self.fuse_steps < 1:
             raise ValueError(f"fuse_steps={fuse_steps} must be >= 1")
+        if mesh is None:
+            from deeplearning4j_tpu.parallel.sharding_registry import (
+                mesh_from_env)
+
+            mesh = mesh_from_env()
         self.engine = DecodeEngine(
             model, slots if slots is not None else serve_slots(),
             max_len=max_len, temperature=temperature, top_k=top_k,
@@ -86,7 +91,7 @@ class DecodeServer:
             draft_layers=(draft_layers if draft_layers is not None
                           else (0 if draft_model is not None
                                 else serve_draft_layers())),
-            spec_tokens=spec_tokens)
+            spec_tokens=spec_tokens, mesh=mesh)
         self.model = model
         self.slots = self.engine.slots
         self.max_len = self.engine.max_len
@@ -535,6 +540,9 @@ class DecodeServer:
             # the draft pool's share when speculative (kv_per_slot_bytes
             # * slots == kv_pool_bytes holds in every configuration)
             "kv_per_slot_bytes": per_slot,
+            # TP serving: the pool shards its head axis over ``model``,
+            # so the per-chip footprint is kv_pool_bytes / kv_shards
+            "kv_shards": self.engine.cache.n_shard,
             "decode_dispatches": self.steps,
             "decode_tokens": self.decode_tokens,
             "dispatches_per_token": (

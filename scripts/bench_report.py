@@ -116,6 +116,13 @@ TRACKED = [
      lambda r: _dig(r, "serve_fleet", "ttft_p50_ms_2r"), "lower"),
     ("serve_fleet_failover_s",
      lambda r: _dig(r, "serve_fleet", "failover_complete_s"), "lower"),
+    # the sharding-registry mesh sweep (PR 17): the most-TP shape's
+    # fused step time and per-chip HBM — TP must keep shrinking
+    # per-chip residency without breaking whole-epoch fusion
+    ("mesh_tp_step_ms",
+     lambda r: _dig(r, "mesh_sweep", "tp_step_ms"), "lower"),
+    ("mesh_tp_per_chip_hbm_mb",
+     lambda r: _dig(r, "mesh_sweep", "tp_per_chip_hbm_mb"), "lower"),
 ]
 
 # direction lookup for scored series; headline:* keys inherit "higher"

@@ -82,10 +82,19 @@
 #                                      invariance, contracts over the
 #                                      mixed program — plus the
 #                                      implicit-f32-promotion lint)
-# The eval/epoch/dp/heal/obs/serve/fleet/serve-slo/lint/profile/mfu
-# tests are part of the default tier-1 run; --eval/--epoch/--dp/--heal/
-# --obs/--serve/--fleet/--serve-slo/--lint/--profile/--mfu are the
-# narrow fast paths for iterating on those surfaces.
+#        scripts/verify.sh --mesh     (the sharding-registry gate: the
+#                                      DP×TP registry suite — spec
+#                                      totality, fused-epoch parity,
+#                                      topology reshard, TP serving —
+#                                      plus the TP/PP parallel suites,
+#                                      the adhoc-out-shardings lint
+#                                      (every placement decision routes
+#                                      through the registry) and the
+#                                      bench trajectory check)
+# The eval/epoch/dp/heal/obs/serve/fleet/serve-slo/lint/profile/mfu/
+# mesh tests are part of the default tier-1 run; --eval/--epoch/--dp/
+# --heal/--obs/--serve/--fleet/--serve-slo/--lint/--profile/--mfu/
+# --mesh are the narrow fast paths for iterating on those surfaces.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -172,6 +181,16 @@ elif [ "${1:-}" = "--mfu" ]; then
     # path may reach a param leaf without policy.cast_compute (the bug
     # class that silently runs the bf16 step at f32 MXU rate)
     python scripts/dl4j_lint.py --select implicit-f32-promotion || exit 1
+elif [ "${1:-}" = "--mesh" ]; then
+    shift
+    TARGET="tests/test_sharding_registry.py tests/test_parallel.py tests/test_dp_epoch.py"
+    # the one-mesh discipline rides along: NamedSharding construction /
+    # out_shardings= pins belong in parallel/sharding_registry.py (or
+    # carry a per-site suppression naming the sanctioned builder)
+    python scripts/dl4j_lint.py --select adhoc-out-shardings || exit 1
+    # the mesh_sweep TRACKED series (tp step time, per-chip HBM) gate
+    # the committed trajectory like every other bench series
+    python scripts/bench_report.py --check BENCH_r*.json || exit 1
 fi
 
 rm -f /tmp/_t1.log

@@ -896,6 +896,76 @@ class TestMarkerAuditRule:
 
 
 # ---------------------------------------------------------------------------
+# adhoc-out-shardings
+# ---------------------------------------------------------------------------
+
+
+class TestAdhocOutShardingsRule:
+    def test_seeded_named_sharding_ctor(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def place(mesh, x):
+                import jax
+                return jax.device_put(x, NamedSharding(mesh, P("data")))
+            """, rule="adhoc-out-shardings",
+            relpath="deeplearning4j_tpu/perf/place_x.py")
+        assert len(found) == 1
+        assert "NamedSharding" in found[0].message
+
+    def test_seeded_dotted_ctor_and_out_shardings_kwarg(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import jax
+
+            def compile_step(mesh, fn, sh):
+                pin = jax.sharding.NamedSharding(mesh, sh)
+                return jax.jit(fn, out_shardings=pin)
+            """, rule="adhoc-out-shardings",
+            relpath="deeplearning4j_tpu/perf/pin_x.py")
+        assert len(found) == 2
+        msgs = " ".join(f.message for f in found)
+        assert "NamedSharding" in msgs and "out_shardings" in msgs
+
+    def test_registry_module_itself_exempt(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            from jax.sharding import NamedSharding
+
+            def named(mesh, spec):
+                return NamedSharding(mesh, spec)
+            """, rule="adhoc-out-shardings",
+            relpath="deeplearning4j_tpu/parallel/sharding_registry.py")
+        assert found == []
+
+    def test_def_header_suppression(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import jax
+
+            def shard_raw(mesh, x, sh):  # dl4j-lint: disable=adhoc-out-shardings -- sanctioned low-level builder
+                return jax.device_put(
+                    x, jax.sharding.NamedSharding(mesh, sh))
+            """, rule="adhoc-out-shardings",
+            relpath="deeplearning4j_tpu/parallel/mesh_x.py")
+        assert found == []
+
+    def test_registry_sourced_shardings_clean(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import jax
+
+            def compile_step(reg, fn, params):
+                shardings = reg.param_shardings(params)
+                return jax.jit(fn), shardings
+            """, rule="adhoc-out-shardings",
+            relpath="deeplearning4j_tpu/perf/clean_x.py")
+        assert found == []
+
+    def test_shipped_tree_clean_under_select(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--select", "adhoc-out-shardings"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, baseline, CLI
 # ---------------------------------------------------------------------------
 

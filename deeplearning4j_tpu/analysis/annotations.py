@@ -93,4 +93,15 @@ HOT_PATH_REGISTRY = frozenset({
     # along into every compiled decode-pool program that reuses them.
     "_slot_export_impl",
     "_slot_import_impl",
+    # nlp/epoch_kernels.py + nlp/glove.py — the fused embedding programs:
+    # in-program pair generation, the masked segment-sum NEG updater, the
+    # whole-chunk scan body, and GloVe's fused AdaGrad epoch scan. The
+    # chunk DRIVER (drive_skipgram_chunks) is the host boundary — its
+    # ledger/heartbeat readbacks must never be reachable from these.
+    "skipgram_pair_plan",
+    "skipgram_negatives",
+    "skipgram_epoch_plan",
+    "_neg_epoch_impl",
+    "_w2v_chunk_impl",
+    "_glove_epoch_impl",
 })

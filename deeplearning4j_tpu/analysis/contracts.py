@@ -44,6 +44,8 @@ __all__ = [
     "fused_program_specs",
     "check_fused_program",
     "check_network_contracts",
+    "embedding_program_specs",
+    "check_embedding_contracts",
 ]
 
 
@@ -327,6 +329,115 @@ def check_fused_program(fn, specs, *, guard: bool, stride: int,
             "params/updater/net-state output pytree structure differs "
             "from the input structure — donation cannot pair buffers")
     return violations
+
+
+def embedding_program_specs(w2v, cache, epochs: int = 2):
+    """``jax.ShapeDtypeStruct`` argument specs for the fused skip-gram
+    chunk program (``nlp/epoch_kernels.make_skipgram_chunk``):
+    ``(syn0, syn1neg, it0, lr0, min_lr, planned, tokens, mask,
+    keep_prob, table, epoch_keys[E])``."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    key_spec = jax.ShapeDtypeStruct((epochs,) + tuple(jnp.shape(key)),
+                                    jnp.result_type(key))
+    scalar = jax.ShapeDtypeStruct((), jnp.float32)
+    return (
+        _specs_of(w2v.syn0),
+        _specs_of(w2v.syn1neg),
+        scalar, scalar, scalar, scalar,
+        _specs_of(cache.tokens),
+        _specs_of(cache.mask),
+        _specs_of(cache.keep_prob),
+        _specs_of(cache.table),
+        key_spec,
+    )
+
+
+def check_embedding_contracts(w2v, cache, *, epochs: int = 2,
+                              allowed_axes: Optional[Sequence[str]] = None,
+                              raise_on_violation: bool = True
+                              ) -> Dict[Tuple, List[str]]:
+    """Contract-check every cached fused skip-gram program on a
+    ``Word2Vec``/``DistributedWord2Vec`` (``_epoch_steps``, populated by
+    ``fit_epochs``): no host callbacks, collectives only over axes the
+    table registry declared (or the cache mesh's axes when the tables
+    were never registered; none at all single-device), donation applied
+    to both tables, outputs ``(syn0, syn1neg, hist[E, n_batches])``.
+    Empty ``_epoch_steps`` raises ValueError — a vacuous pass must never
+    look like a checked one."""
+    import jax
+
+    programs = getattr(w2v, "_epoch_steps", None) or {}
+    if not programs:
+        raise ValueError(
+            "no cached fused skip-gram programs on %r (_epoch_steps is "
+            "empty) — run fit_epochs first" % type(w2v).__name__)
+    if allowed_axes is None:
+        registry = getattr(w2v, "_sharding_registry", None)
+        if registry is not None:
+            allowed_axes = tuple(sorted(registry.declared_axes))
+        elif getattr(cache, "mesh", None) is not None:
+            allowed_axes = tuple(cache.mesh.axis_names)
+        else:
+            allowed_axes = ()
+    specs = embedding_program_specs(w2v, cache, epochs)
+    results: Dict[Tuple, List[str]] = {}
+    for key, fn in sorted(programs.items(), key=repr):
+        violations: List[str] = []
+        jaxpr = _trace_jaxpr(fn, specs)
+        cbs = callback_primitives(jaxpr)
+        if cbs:
+            violations.append(
+                f"host callback primitive(s) {cbs} inside the fused "
+                "skip-gram program")
+        allowed = set(allowed_axes)
+        for ax, prims in sorted(collective_axes(jaxpr).items()):
+            if ax not in allowed:
+                violations.append(
+                    f"collective(s) {prims} over undeclared mesh axis "
+                    f"'{ax}' (declared: {sorted(allowed) or 'none'})")
+        try:
+            text = fn.lower(*specs).as_text()
+        except Exception as exc:
+            violations.append(
+                f"could not lower program for donation check: {exc!r}")
+        else:
+            donated = set(donated_arg_indices(text))
+            missing = [i for i in (0, 1) if i not in donated]
+            if missing:
+                violations.append(
+                    f"table arg(s) {missing} lack an input-output alias "
+                    "— donation was dropped and each chunk doubles the "
+                    "tables' HBM footprint")
+        try:
+            out = jax.eval_shape(fn, *specs)
+        except Exception as exc:
+            violations.append(f"could not eval_shape program: {exc!r}")
+            out = None
+        if out is not None:
+            if not isinstance(out, tuple) or len(out) != 3:
+                violations.append(
+                    "program must return (syn0, syn1neg, hist), got "
+                    f"{len(out) if isinstance(out, tuple) else type(out).__name__}")
+            else:
+                for i, (o, ref) in enumerate(zip(out[:2],
+                                                 (w2v.syn0, w2v.syn1neg))):
+                    if tuple(o.shape) != tuple(ref.shape):
+                        violations.append(
+                            f"output {i} shape {tuple(o.shape)} != table "
+                            f"shape {tuple(ref.shape)}")
+                hist = out[2]
+                if tuple(hist.shape) != (epochs, cache.n_batches):
+                    violations.append(
+                        f"loss history shape {tuple(hist.shape)} != "
+                        f"({epochs}, {cache.n_batches})")
+        results[key] = [f"program {key}: {v}" for v in violations]
+    flat = [v for vs in results.values() for v in vs]
+    if flat and raise_on_violation:
+        raise ContractViolation(flat)
+    return results
 
 
 def check_network_contracts(net, cache, *, epochs: int = 2,

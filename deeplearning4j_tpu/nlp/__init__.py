@@ -22,6 +22,9 @@ from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
 )
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabWord  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.epoch_kernels import (  # noqa: F401
+    SkipGramCorpusCache,
+)
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors  # noqa: F401
 from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec_iterator import (  # noqa: F401

@@ -66,10 +66,49 @@ class DistributedWord2Vec(Word2Vec):
             mesh = build_mesh()
         self.mesh = mesh
         self._sharded_step = make_sharded_neg_step(mesh)
+        self._heartbeat = None
+        self._heartbeat_stats = {}
 
     @property
     def data_parallelism(self) -> int:
         return self.mesh.shape[DATA_AXIS]
+
+    def fit_epochs(self, num_epochs: Optional[int] = None, *,
+                   cache=None, chunk_epochs=None, on_chunk=None,
+                   mesh=None, budget_mb=None):
+        """Fused epochs on ``self.mesh`` by default — the corpus cache,
+        chunk program, and table registry all land on the mesh this
+        instance was built for."""
+        return super().fit_epochs(
+            num_epochs, cache=cache, chunk_epochs=chunk_epochs,
+            on_chunk=on_chunk, mesh=self.mesh if mesh is None else mesh,
+            budget_mb=budget_mb)
+
+    # ------------------------------------------------------------------
+    # fleet wiring: embedding runs look like any other worker
+    # ------------------------------------------------------------------
+    def attach_heartbeat(self, tracker, worker_id: str,
+                         interval_s: float = 5.0):
+        """Post words/sec + loss payloads to a cluster state tracker so
+        the fleet master tick, straggler flagging, and goodput autopilot
+        see this run like any dense worker. The fused chunk driver
+        refreshes ``_heartbeat_stats`` once per chunk (one sanctioned
+        scalar readback); the monitor thread ships whatever is current.
+
+        Returns the :class:`HeartbeatMonitor` — use it as a context
+        manager around training, or call ``start()``/``stop()``."""
+        from deeplearning4j_tpu.parallel.cluster import HeartbeatMonitor
+
+        def payload():
+            stats = dict(self._heartbeat_stats)
+            # the master tick reads step_s/last_loss/goodput_pct; extra
+            # keys (words_per_sec, epochs_done) ride along for dashboards
+            return stats
+
+        self._heartbeat = HeartbeatMonitor(
+            tracker, worker_id, interval_s=interval_s,
+            payload_fn=payload)
+        return self._heartbeat
 
     def _neg_batch(self, c: np.ndarray, x: np.ndarray, lr: float):
         c = np.asarray(c, np.int32)

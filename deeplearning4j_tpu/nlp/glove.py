@@ -1,10 +1,13 @@
-"""GloVe: windowed co-occurrence counting + batched AdaGrad WLS on device.
+"""GloVe: windowed co-occurrence counting + fused AdaGrad WLS on device.
 
 Mirror of models/glove/ (Glove.java:413, AbstractCoOccurrences.java:624
 windowed counting with disk spill, GloveWeightLookupTable AdaGrad updates).
 Counting stays host-side (hash map; the corpus scan is IO-bound); the
-weighted-least-squares updates run as one jitted AdaGrad step per shuffled
-batch of (i, j, X_ij) triples.
+weighted-least-squares updates run the fused-epoch way (the word2vec
+``nlp/epoch_kernels`` model): ALL epochs × batches of (i, j, X_ij)
+triples inside one donated ``lax.scan`` program, with the per-epoch
+shuffle done in-program from ``fold_in(seed, epoch)`` keys — one
+dispatch per ``fit()``, counter-asserted like the skip-gram path.
 """
 
 from __future__ import annotations
@@ -17,34 +20,85 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.analysis.annotations import traced
 from deeplearning4j_tpu.nlp.sentence_iterator import SentenceIterator
 from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
     TokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.word2vec import _row_scale
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
-def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
-    """AdaGrad step on J = Σ f(x)(w_i·w̃_j + b_i + b̃_j − log x)²."""
+def _glove_step_math(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx,
+                     lr):
+    """AdaGrad step on J = Σ f(x)(w_i·w̃_j + b_i + b̃_j − log x)².
+
+    Masked for the fused path's padding: a triple with ``fx == 0`` is
+    inert (zero gradient, zero accumulator growth) and excluded from the
+    loss mean. Duplicate rows in one batch mean-normalize via the shared
+    ``_row_scale`` joint-count accumulation (weighted by validity, the
+    word2vec rule) so padded/duplicated triples re-weight real updates
+    instead of multiplying the effective learning rate."""
+    valid = (fx > 0).astype(jnp.float32)
     wi = w[rows]
     wj = wc[cols]
     diff = jnp.sum(wi * wj, axis=-1) + b[rows] + bc[cols] - logx  # [B]
-    loss = jnp.mean(fx * diff * diff)
+    loss = jnp.sum(fx * diff * diff) / jnp.maximum(jnp.sum(valid), 1.0)
     g = fx * diff                                                # [B]
     gwi = g[:, None] * wj
     gwj = g[:, None] * wi
-    # AdaGrad accumulators (per-row history, gathered then scattered back)
+    # AdaGrad accumulators (per-row history, gathered then scattered
+    # back) keep the SUMMED g² — history is a sum by definition
     hw = hw.at[rows].add(gwi * gwi)
     hwc = hwc.at[cols].add(gwj * gwj)
     hb = hb.at[rows].add(g * g)
     hbc = hbc.at[cols].add(g * g)
-    w = w.at[rows].add(-lr * gwi / (jnp.sqrt(hw[rows]) + 1e-8))
-    wc = wc.at[cols].add(-lr * gwj / (jnp.sqrt(hwc[cols]) + 1e-8))
-    b = b.at[rows].add(-lr * g / (jnp.sqrt(hb[rows]) + 1e-8))
-    bc = bc.at[cols].add(-lr * g / (jnp.sqrt(hbc[cols]) + 1e-8))
+    sr = _row_scale(w.shape[0], rows, valid)
+    sc = _row_scale(wc.shape[0], cols, valid)
+    w = w.at[rows].add(-lr * gwi / (jnp.sqrt(hw[rows]) + 1e-8)
+                       * sr[:, None])
+    wc = wc.at[cols].add(-lr * gwj / (jnp.sqrt(hwc[cols]) + 1e-8)
+                         * sc[:, None])
+    b = b.at[rows].add(-lr * g / (jnp.sqrt(hb[rows]) + 1e-8) * sr)
+    bc = bc.at[cols].add(-lr * g / (jnp.sqrt(hbc[cols]) + 1e-8) * sc)
     return w, wc, b, bc, hw, hwc, hb, hbc, loss
+
+
+# the per-batch step, still exported for the host-reference equivalence
+# tests (the fused run below applies the SAME math inside its scan)
+_glove_step = jax.jit(_glove_step_math, donate_argnums=(0, 1, 2, 3, 4, 5,
+                                                        6, 7))
+
+
+@functools.lru_cache(maxsize=8)
+def _make_glove_run(n_batches: int, batch: int):
+    """ONE donated program running E epochs × N batches of AdaGrad:
+    ``(tables(8), rows, cols, logx, fx, lr, epoch_keys[E]) ->
+    (tables, hist[E, N])``; the epoch shuffle is a pure function of
+    each epoch's key, so the whole loop fuses."""
+
+    @traced
+    def _glove_epoch_impl(tables, rows, cols, logx, fx, lr, epoch_keys):
+        def epoch_body(carry, ekey):
+            order = jax.random.permutation(ekey, rows.shape[0])
+            xs = (rows[order].reshape(n_batches, batch),
+                  cols[order].reshape(n_batches, batch),
+                  logx[order].reshape(n_batches, batch),
+                  fx[order].reshape(n_batches, batch))
+
+            def batch_body(tbl, x):
+                *out, loss = _glove_step_math(*tbl, x[0], x[1], x[2],
+                                              x[3], lr)
+                return tuple(out), loss
+
+            carry, losses = jax.lax.scan(batch_body, carry, xs)
+            return carry, losses
+
+        tables, hist = jax.lax.scan(epoch_body, tables, epoch_keys)
+        return tables, hist
+
+    return jax.jit(_glove_epoch_impl, donate_argnums=(0,))
 
 
 class Glove:
@@ -120,6 +174,7 @@ class Glove:
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[np.ndarray] = None  # w + wc merged after fit
         self._rng = np.random.default_rng(seed)
+        self._train_dispatches = 0  # fused-run counter (bench asserts 1)
 
     def _sentences_tokens(self):
         self.sentence_iterator.reset()
@@ -250,19 +305,35 @@ class Glove:
         hwc = jnp.full((n, d), 1e-8, jnp.float32)
         hb = jnp.full((n,), 1e-8, jnp.float32)
         hbc = jnp.full((n,), 1e-8, jnp.float32)
-        logx = np.log(x)
+        logx = np.log(np.maximum(x, 1e-12)).astype(np.float32)
         fx = np.minimum(1.0, (x / self.x_max) ** self.alpha).astype(np.float32)
-        for _ in range(self.epochs):
-            order = self._rng.permutation(len(rows))
-            for s in range(0, len(order), self.batch_size):
-                sel = order[s:s + self.batch_size]
-                (w, wc, b, bc, hw, hwc, hb, hbc, loss) = _glove_step(
-                    w, wc, b, bc, hw, hwc, hb, hbc,
-                    jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
-                    jnp.asarray(logx[sel]), jnp.asarray(fx[sel]),
-                    self.learning_rate)
+        if len(rows) == 0 or self.epochs <= 0:
+            self.syn0 = np.asarray(w) + np.asarray(wc)
+            self._loss = float("nan")
+            return self
+        # fused run: pad the triples to N*B with fx=0 (inert under the
+        # masked step), then ONE donated program for all epochs — the
+        # in-program shuffle replaces the host permutation per epoch
+        batch = min(self.batch_size, max(32, len(rows) // 8))
+        n_batches = -(-len(rows) // batch)
+        total = n_batches * batch
+        pad = total - len(rows)
+        rows = np.pad(rows.astype(np.int32), (0, pad))
+        cols = np.pad(cols.astype(np.int32), (0, pad))
+        logx = np.pad(logx, (0, pad))
+        fx = np.pad(fx, (0, pad))
+        base = jax.random.PRNGKey(self.seed)
+        keys = jax.vmap(lambda e: jax.random.fold_in(base, e))(
+            jnp.arange(self.epochs))
+        run = _make_glove_run(n_batches, batch)
+        tables, hist = run(
+            (w, wc, b, bc, hw, hwc, hb, hbc), jnp.asarray(rows),
+            jnp.asarray(cols), jnp.asarray(logx), jnp.asarray(fx),
+            jnp.asarray(self.learning_rate, jnp.float32), keys)
+        self._train_dispatches += 1
+        w, wc = tables[0], tables[1]
         self.syn0 = np.asarray(w) + np.asarray(wc)  # standard GloVe merge
-        self._loss = float(loss)
+        self._loss = float(np.asarray(hist[-1, -1]))
         return self
 
     # --- lookups (same surface as Word2Vec) ---

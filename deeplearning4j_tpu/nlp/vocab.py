@@ -174,6 +174,23 @@ def padded_huffman_paths(vocab: VocabCache):
     return padded_paths(codes_list, points_list)
 
 
+def subsample_keep_prob(vocab: VocabCache, sampling: float) -> np.ndarray:
+    """``[V]`` frequent-word keep probabilities (SkipGram's sampling
+    rule): ``keep = (sqrt(f/s) + 1) * s/f`` clipped to [0, 1], all-ones
+    when sampling is off. ONE derivation shared by the host emitter
+    (``Word2Vec._corpus_indices``) and the device corpus cache
+    (``nlp/epoch_kernels``) so both paths subsample the same
+    distribution."""
+    n = vocab.num_words()
+    if sampling <= 0 or n == 0:
+        return np.ones((max(n, 1),), np.float32)
+    total = max(vocab.total_word_count, 1)
+    counts = np.asarray([w.count for w in vocab.vocab_words()], np.float64)
+    f = np.maximum(counts / total, 1e-12)
+    keep = (np.sqrt(f / sampling) + 1.0) * sampling / f
+    return np.clip(keep, 0.0, 1.0).astype(np.float32)
+
+
 def unigram_table(vocab: VocabCache, table_size: int = 1_000_000,
                   power: float = 0.75) -> np.ndarray:
     """Negative-sampling unigram table (InMemoryLookupTable's ``table``):

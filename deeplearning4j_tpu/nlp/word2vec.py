@@ -34,6 +34,7 @@ from deeplearning4j_tpu.nlp.vocab import (
     VocabCache,
     build_vocab,
     padded_huffman_paths,
+    subsample_keep_prob,
     unigram_table,
 )
 
@@ -43,13 +44,21 @@ from deeplearning4j_tpu.nlp.vocab import (
 # ---------------------------------------------------------------------------
 
 
-def _row_scale(n_rows, idx):
+def _row_scale(n_rows, idx, weights=None):
     """1/count-per-row scaling for scatter-adds: a row hit k times in one
     batch receives the MEAN of its k per-pair updates rather than the sum.
     Without this, small vocabs (row hit ~B/V times per batch) multiply the
     effective learning rate by the hit count and diverge — the sequential
-    reference recomputes σ between pair updates, which bounds step size."""
-    counts = jnp.zeros((n_rows,), jnp.float32).at[idx.reshape(-1)].add(1.0)
+    reference recomputes σ between pair updates, which bounds step size.
+
+    ``weights`` (optional, same shape as ``idx``) weights the per-row
+    counting — the masked fused paths (``nlp/epoch_kernels``, GloVe's
+    padded epoch scan) pass their validity mask so pad slots neither
+    update a row nor dilute its mean."""
+    contrib = (jnp.ones(idx.shape, jnp.float32) if weights is None
+               else weights.astype(jnp.float32))
+    counts = jnp.zeros((n_rows,), jnp.float32).at[
+        idx.reshape(-1)].add(contrib.reshape(-1))
     return 1.0 / jnp.maximum(counts[idx], 1.0)
 
 
@@ -262,6 +271,16 @@ class Word2Vec:
         self._table: Optional[np.ndarray] = None
         self._rng = np.random.default_rng(seed)
         self._norm_cache: Optional[np.ndarray] = None
+        # fused-epoch state (nlp/epoch_kernels): chunk-boundary hooks,
+        # the compiled-program cache the contract checker walks, and the
+        # dispatch counter bench/dryrun assert on
+        self.listeners: list = []
+        self.iteration_count = 0
+        self._train_dispatches = 0
+        self._epochs_done = 0
+        self._epoch_steps: Dict[tuple, object] = {}
+        self._corpus_cache = None
+        self._sharding_registry = None
 
     # ------------------------------------------------------------------
     def _sentences_tokens(self) -> Iterable[List[str]]:
@@ -290,20 +309,20 @@ class Word2Vec:
         return self
 
     # ------------------------------------------------------------------
-    def _corpus_indices(self) -> List[np.ndarray]:
+    def _corpus_indices(self, subsample: bool = True) -> List[np.ndarray]:
         """Sentences as filtered index arrays with frequent-word
         subsampling (SkipGram's sampling logic). Vectorized: one dict
         lookup per token, then numpy masking — the per-token Python
-        branch-work of the original loop dominated profile time."""
+        branch-work of the original loop dominated profile time.
+
+        ``subsample=False`` keeps frequent words: the fused corpus cache
+        (``nlp/epoch_kernels``) drains raw indices and re-rolls the SAME
+        ``subsample_keep_prob`` table in-program, per epoch."""
         out = []
-        total = max(self.vocab.total_word_count, 1)
         tok2idx = {w.word: w.index for w in self.vocab.vocab_words()}
         keep_prob = None
-        if self.sampling > 0:
-            counts = np.asarray(
-                [w.count for w in self.vocab.vocab_words()], np.float64)
-            f = np.maximum(counts / total, 1e-12)
-            keep_prob = (np.sqrt(f / self.sampling) + 1) * self.sampling / f
+        if subsample and self.sampling > 0:
+            keep_prob = subsample_keep_prob(self.vocab, self.sampling)
         for tokens in self._sentences_tokens():
             if not tokens:
                 continue
@@ -342,6 +361,145 @@ class Word2Vec:
             contexts_parts.append(words[:-d][m_right])
         return (np.concatenate(centers_parts).astype(np.int32),
                 np.concatenate(contexts_parts).astype(np.int32))
+
+    # ------------------------------------------------------------------
+    # fused whole-epoch path (nlp/epoch_kernels) — the sparse sibling of
+    # MultiLayerNetwork.fit_epochs
+    # ------------------------------------------------------------------
+    def build_corpus_cache(self, budget_mb: Optional[float] = None,
+                           mesh=None):
+        """Stage the corpus on-device for fused training (None over
+        budget / empty corpus — callers fall back to the host loop)."""
+        from deeplearning4j_tpu.nlp import epoch_kernels
+
+        if self.vocab is None:
+            self.build_vocab()
+        cache = epoch_kernels.SkipGramCorpusCache.build(
+            self, budget_mb=budget_mb, mesh=mesh)
+        self._corpus_cache = cache
+        return cache
+
+    def _fused_mode(self, mesh) -> str:
+        """How the fused program runs on ``mesh``: ``"rows"`` (tables
+        row-sharded over ``model`` — GSPMD partitions the same program),
+        ``"dp"`` (batch split over ``data`` inside shard_map), or
+        ``"single"``."""
+        from deeplearning4j_tpu.nlp.epoch_kernels import w2v_row_shard_mode
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            model_axis_size,
+        )
+
+        if mesh is None:
+            return "single"
+        tp = model_axis_size(mesh)
+        mode = w2v_row_shard_mode()
+        if tp > 1 and mode != "0":
+            if self.vocab.num_words() % tp == 0:
+                return "rows"
+            if mode == "1":
+                import logging
+                logging.getLogger(__name__).warning(
+                    "DL4J_W2V_ROW_SHARD=1 but vocab %d does not tile the "
+                    "model axis (size %d) — tables stay replicated",
+                    self.vocab.num_words(), tp)
+        if int(mesh.shape.get("data", 1)) > 1:
+            return "dp"
+        return "single"
+
+    def _register_tables(self, cache):
+        """syn0/syn1neg into PR 17's ShardingRegistry: row-sharded over
+        ``model`` when ``_fused_mode`` says so, else explicit-replicated.
+        Places the live tables and stamps ``_sharding_registry`` (the
+        contract checker's declared-axes source)."""
+        from deeplearning4j_tpu.parallel.sharding_registry import (
+            ShardingRegistry,
+        )
+
+        mesh = cache.mesh
+        if mesh is None:
+            self._sharding_registry = None
+            return None
+        mode = self._fused_mode(mesh)
+        tables = {"syn0": self.syn0, "syn1neg": self.syn1neg}
+        reg = ShardingRegistry.for_embedding_tables(
+            tables, mesh, row_shard=(mode == "rows"),
+            name=type(self).__name__)
+        placed = reg.place(tables)
+        self.syn0, self.syn1neg = placed["syn0"], placed["syn1neg"]
+        self._sharding_registry = reg
+        return reg
+
+    def _skipgram_program(self, cache):
+        """The compiled chunk program for ``cache``'s geometry, built
+        once and cached in ``_epoch_steps`` (the contract checker and
+        profiler walk this dict like the dense networks')."""
+        from deeplearning4j_tpu.monitor.profile import ProfiledProgram
+        from deeplearning4j_tpu.nlp.epoch_kernels import make_skipgram_chunk
+
+        mode = self._fused_mode(cache.mesh)
+        key = (self.vocab.num_words(), self.layer_size, cache.n_batches,
+               cache.batch, cache.window, cache.negative, mode,
+               cache.n_shard)
+        prog = self._epoch_steps.get(key)
+        if prog is None:
+            prog = ProfiledProgram(
+                make_skipgram_chunk(cache, dp=(mode == "dp")),
+                name="w2v_epoch_chunk", key=key)
+            self._epoch_steps[key] = prog
+        return prog
+
+    def _host_fallback(self, num_epochs: int):
+        """Host pair-loop fallback for ``fit_epochs`` (HS/CBOW, fused
+        disabled, or cache over budget): run ``fit()`` for exactly
+        ``num_epochs`` without disturbing the configured schedule."""
+        saved = self.epochs
+        try:
+            self.epochs = num_epochs
+            self.fit()
+        finally:
+            self.epochs = saved
+        self._epochs_done += num_epochs
+        return None
+
+    def fit_epochs(self, num_epochs: Optional[int] = None, *,
+                   cache=None, chunk_epochs: Optional[int] = None,
+                   on_chunk=None, mesh=None,
+                   budget_mb: Optional[float] = None):
+        """Fused whole-epoch training: E epochs × N batches as ONE
+        donated program per chunk. Returns the ``[E, N]`` loss history,
+        or ``None`` when the host loop ran instead (HS/CBOW corpora,
+        ``DL4J_W2V_FUSED=0``, or a cache over the HBM budget — same
+        silent-fallback contract as the dense epoch cache)."""
+        from deeplearning4j_tpu.nlp import epoch_kernels
+
+        if num_epochs is None:
+            num_epochs = self.epochs
+        num_epochs = int(num_epochs)
+        if num_epochs <= 0:
+            return None
+        if self.vocab is None:
+            self.build_vocab()
+        if self.syn0 is None:
+            self.reset_weights()
+        if (self.hierarchic_softmax or self.algorithm == "cbow"
+                or not epoch_kernels.w2v_fused_enabled()):
+            return self._host_fallback(num_epochs)
+        if cache is None:
+            cache = self._corpus_cache
+            if cache is None or (mesh is not None
+                                 and cache.mesh is not mesh):
+                cache = self.build_corpus_cache(budget_mb=budget_mb,
+                                                mesh=mesh)
+        if cache is None:
+            return self._host_fallback(num_epochs)
+        self._corpus_cache = cache
+        if cache.mesh is not None and self._sharding_registry is None:
+            self._register_tables(cache)
+        hist = epoch_kernels.drive_skipgram_chunks(
+            self, cache, num_epochs, chunk_epochs=chunk_epochs,
+            on_chunk=on_chunk)
+        self._norm_cache = None
+        return hist
 
     # ------------------------------------------------------------------
     def fit(self) -> "Word2Vec":

@@ -321,6 +321,27 @@ class ShardingRegistry:
                                             "TransformerLM"),
                    name="TransformerLM")
 
+    @classmethod
+    def for_embedding_tables(cls, tables: Dict[str, Any], mesh: Mesh, *,
+                             row_shard: bool = False,
+                             name: str = "Word2Vec") -> "ShardingRegistry":
+        """Registry for sparse embedding tables (word2vec's syn0/syn1neg,
+        GloVe's w/wc): ``row_shard=True`` splits the VOCAB dim over
+        ``model`` — ``P('model', None)``, the layout GSPMD partitions the
+        fused skip-gram program's gathers/scatters around once a table
+        outgrows one chip — else explicit replicate-all (the DP path:
+        every device carries the tables, deltas all-reduce over
+        ``data``). Same strictness as the network constructors: uneven
+        vocab demotes LOUDLY via ``_divisible_or_replicated``."""
+        if row_shard and model_axis_size(mesh) > 1:
+            raw = {k: P(MODEL_AXIS, None) for k in tables}
+        else:
+            raw = _replicate_all_tree(tables)
+        expanded = _expand(tables, raw, (), name)
+        return cls(mesh,
+                   _divisible_or_replicated(tables, expanded, mesh, name),
+                   name=name)
+
     # ------------------------------------------------------------------
     # lookup
     # ------------------------------------------------------------------

@@ -123,6 +123,13 @@ TRACKED = [
      lambda r: _dig(r, "mesh_sweep", "tp_step_ms"), "lower"),
     ("mesh_tp_per_chip_hbm_mb",
      lambda r: _dig(r, "mesh_sweep", "tp_per_chip_hbm_mb"), "lower"),
+    # the fused embeddings push (PR 18): words/sec gates higher (the
+    # section's headline words_per_sec switched from the host loop to
+    # the fused program this round), dispatches/epoch must stay at 1
+    ("w2v_words_per_sec",
+     lambda r: _dig(r, "word2vec", "words_per_sec"), "higher"),
+    ("w2v_dispatches_per_epoch",
+     lambda r: _dig(r, "word2vec", "dispatches_per_epoch"), "lower"),
 ]
 
 # direction lookup for scored series; headline:* keys inherit "higher"

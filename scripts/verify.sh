@@ -82,6 +82,17 @@
 #                                      invariance, contracts over the
 #                                      mixed program — plus the
 #                                      implicit-f32-promotion lint)
+#        scripts/verify.sh --nlp      (the fused-embeddings gate: the
+#                                      NLP suites + the fused skip-gram
+#                                      equivalence/contract tests and the
+#                                      sharded DP/row-sharded parity
+#                                      suite, plus the host-sync +
+#                                      adhoc-out-shardings lint over
+#                                      nlp/ (the chunk driver's ledger/
+#                                      heartbeat readbacks must never
+#                                      ride into the traced programs;
+#                                      table placement routes through
+#                                      the registry))
 #        scripts/verify.sh --mesh     (the sharding-registry gate: the
 #                                      DP×TP registry suite — spec
 #                                      totality, fused-epoch parity,
@@ -181,6 +192,16 @@ elif [ "${1:-}" = "--mfu" ]; then
     # path may reach a param leaf without policy.cast_compute (the bug
     # class that silently runs the bf16 step at f32 MXU rate)
     python scripts/dl4j_lint.py --select implicit-f32-promotion || exit 1
+elif [ "${1:-}" = "--nlp" ]; then
+    shift
+    TARGET="tests/test_nlp.py tests/test_nlp_fused.py tests/test_distributed_nlp.py"
+    # the fused embedding programs are hot roots like the dense chunk
+    # programs: no host syncs reachable from the traced pair-gen/updater
+    # kernels, and no ad-hoc NamedSharding — syn0/syn1neg placement goes
+    # through ShardingRegistry.for_embedding_tables
+    python scripts/dl4j_lint.py \
+        --select host-sync-in-hot-path,adhoc-out-shardings \
+        deeplearning4j_tpu/nlp || exit 1
 elif [ "${1:-}" = "--mesh" ]; then
     shift
     TARGET="tests/test_sharding_registry.py tests/test_parallel.py tests/test_dp_epoch.py"

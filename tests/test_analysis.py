@@ -174,7 +174,8 @@ class TestHostSyncRule:
         import ast
 
         defined = set()
-        for sub in ("nn", "perf", "monitor", "resilience", "serving"):
+        for sub in ("nn", "perf", "monitor", "resilience", "serving",
+                    "nlp"):
             base = os.path.join(REPO, "deeplearning4j_tpu", sub)
             for root, _, files in os.walk(base):
                 for name in files:

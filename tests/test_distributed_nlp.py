@@ -54,6 +54,131 @@ class TestDistributedWord2Vec:
         assert np.isfinite(np.asarray(vec.syn0)).all()
 
 
+class TestFusedSharded:
+    """ISSUE 18: the fused whole-epoch skip-gram program on a mesh — DP
+    (batch split inside shard_map) and row-sharded tables (model axis,
+    GSPMD) must both stay within 1e-6 of the single-device program."""
+
+    def _sentences(self, rng, n_words=40, n_sent=100):
+        words = [f"w{i}" for i in range(n_words)]
+        return [" ".join(words[i] for i in rng.integers(0, n_words,
+                                                        rng.integers(3, 12)))
+                for _ in range(n_sent)]
+
+    def _make(self, sents, mesh=None, **kw):
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        kw.setdefault("min_word_frequency", 1)
+        kw.setdefault("layer_size", 16)
+        kw.setdefault("window_size", 2)
+        kw.setdefault("negative", 3)
+        kw.setdefault("seed", 0)
+        kw.setdefault("epochs", 2)
+        cls = Word2Vec if mesh is None else DistributedWord2Vec
+        if mesh is not None:
+            kw["mesh"] = mesh
+        vec = cls(sentence_iterator=CollectionSentenceIterator(sents),
+                  **kw)
+        vec.build_vocab()
+        vec.reset_weights()
+        return vec
+
+    def _single_reference(self, sents, batch):
+        from deeplearning4j_tpu.nlp.epoch_kernels import (
+            SkipGramCorpusCache,
+        )
+
+        sv = self._make(sents)
+        cache = SkipGramCorpusCache.build(sv, batch=batch)
+        hist = sv.fit_epochs(2, cache=cache)
+        return sv, hist
+
+    def test_dp_matches_single_device(self, rng):
+        import jax
+
+        sents = self._sentences(rng)
+        mesh = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        dw = self._make(sents, mesh=mesh)
+        hist = dw.fit_epochs(2)
+        assert dw._train_dispatches == 1
+        sv, ref_hist = self._single_reference(sents,
+                                              dw._corpus_cache.batch)
+        np.testing.assert_allclose(np.asarray(hist), np.asarray(ref_hist),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw.syn0),
+                                   np.asarray(sv.syn0), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw.syn1neg),
+                                   np.asarray(sv.syn1neg), atol=1e-6)
+
+    def test_row_sharded_matches_single_device(self, rng):
+        """Tables P('model', None) from the registry, SAME program under
+        GSPMD: physically sharded rows, numerics within 1e-6."""
+        import jax
+
+        sents = self._sentences(rng)  # 40 words tile the 2-way model axis
+        mesh = build_mesh(MeshSpec(data=1, model=2),
+                          devices=jax.devices()[:2])
+        dw = self._make(sents, mesh=mesh)
+        assert dw._fused_mode(mesh) == "rows"
+        hist = dw.fit_epochs(2)
+        assert dw._train_dispatches == 1
+        reg = dw._sharding_registry
+        assert reg is not None and "model" in reg.declared_axes
+        shards = dw.syn0.addressable_shards
+        assert len(shards) == 2
+        assert shards[0].data.shape[0] == dw.vocab.num_words() // 2
+        sv, ref_hist = self._single_reference(sents,
+                                              dw._corpus_cache.batch)
+        np.testing.assert_allclose(np.asarray(hist), np.asarray(ref_hist),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw.syn0),
+                                   np.asarray(sv.syn0), atol=1e-6)
+
+    def test_sharded_program_contracts(self, rng):
+        """PR-7 checks on the DP program: collectives ONLY over the
+        registry-declared axes, donation on both tables."""
+        import jax
+
+        from deeplearning4j_tpu.analysis.contracts import (
+            check_embedding_contracts,
+        )
+
+        mesh = build_mesh(MeshSpec(data=2), devices=jax.devices()[:2])
+        dw = self._make(self._sentences(rng), mesh=mesh)
+        dw.fit_epochs(2)
+        results = check_embedding_contracts(dw, dw._corpus_cache,
+                                            epochs=2)
+        assert all(not v for v in results.values())
+
+    def test_heartbeat_posts_words_per_sec(self, rng):
+        """Workers post words/sec + loss payloads the fleet master tick
+        aggregates (step_s / last_loss are the keys it reads)."""
+        import jax
+
+        from deeplearning4j_tpu.parallel.statetracker import (
+            InMemoryStateTracker,
+        )
+
+        mesh = build_mesh(MeshSpec(data=2), devices=jax.devices()[:2])
+        dw = self._make(self._sentences(rng), mesh=mesh, epochs=4)
+        tracker = InMemoryStateTracker()
+        monitor = dw.attach_heartbeat(tracker, "w2v-worker-0",
+                                      interval_s=0.05)
+        with monitor:
+            dw.fit_epochs(4, chunk_epochs=1)
+            # stats are refreshed per chunk; force one beat with them
+            monitor._post()
+        metrics = tracker.heartbeat_metrics("w2v-worker-0")
+        assert metrics is not None
+        assert metrics["step_s"] > 0
+        assert metrics["words_per_sec"] > 0
+        assert np.isfinite(metrics["last_loss"])
+        assert metrics["epochs_done"] == 4
+
+
 class TestDistributedEvaluate:
     def test_wrapper_evaluate_merges(self, rng):
         from deeplearning4j_tpu.datasets.dataset import DataSet

@@ -1,0 +1,349 @@
+"""Fused whole-epoch skip-gram + GloVe (nlp/epoch_kernels, ISSUE 18).
+
+The equivalence contract under test: the in-program pair generator is a
+pure function of per-epoch ``jax.random`` keys, so the SAME derivation
+run eagerly (host reference) and traced (fused chunk program) consumes
+identical RNG streams — the fused path is tested against an eager
+replay of itself plus, at window=1 (where the reduced window is
+deterministic), against the legacy host emitter's exact pair multiset.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp import Word2Vec
+from deeplearning4j_tpu.nlp.epoch_kernels import (
+    SkipGramCorpusCache,
+    _neg_epoch_impl,
+    epoch_keys_for,
+    skipgram_epoch_plan,
+    skipgram_pair_plan,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+)
+
+
+def _sentences(rng, n_words=40, n_sent=70, lo=3, hi=12):
+    words = [f"w{i}" for i in range(n_words)]
+    return [" ".join(rng.choice(words, size=rng.integers(lo, hi)))
+            for _ in range(n_sent)]
+
+
+def _w2v(sents, **kw):
+    kw.setdefault("min_word_frequency", 1)
+    kw.setdefault("layer_size", 16)
+    kw.setdefault("window_size", 3)
+    kw.setdefault("negative", 5)
+    kw.setdefault("seed", 0)
+    kw.setdefault("epochs", 2)
+    w = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents), **kw)
+    w.build_vocab()
+    w.reset_weights()
+    return w
+
+
+class TestPairPlanEquivalence:
+    def test_window1_matches_host_emitter_multiset(self, rng):
+        """At window=1 the reduced window b ~ U{1..1} is deterministic, so
+        the fused plan's valid pairs must be EXACTLY the host emitter's
+        multiset (sampling off ⇒ no RNG in either path's selection)."""
+        w2v = _w2v(_sentences(rng), window_size=1, sampling=0.0)
+        sentences = w2v._corpus_indices(subsample=False)
+        host_c, host_x = w2v._emit_pairs(sentences)
+
+        cache = SkipGramCorpusCache.build(w2v)
+        cen, ctx, val = skipgram_pair_plan(
+            jax.random.PRNGKey(7), cache.tokens, cache.mask,
+            cache.keep_prob, cache.window)
+        m = np.asarray(val) > 0
+        fused = sorted(zip(np.asarray(cen)[m].tolist(),
+                           np.asarray(ctx)[m].tolist()))
+        host = sorted(zip(host_c.tolist(), host_x.tolist()))
+        assert fused == host
+
+    def test_plan_is_key_deterministic(self, rng):
+        w2v = _w2v(_sentences(rng))
+        cache = SkipGramCorpusCache.build(w2v)
+        k = jax.random.PRNGKey(3)
+        a = skipgram_epoch_plan(k, cache.tokens, cache.mask,
+                                cache.keep_prob, cache.table, cache.window,
+                                cache.negative, cache.n_batches, cache.batch)
+        b = skipgram_epoch_plan(k, cache.tokens, cache.mask,
+                                cache.keep_prob, cache.table, cache.window,
+                                cache.negative, cache.n_batches, cache.batch)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_negative_draw_distribution_follows_table(self, rng):
+        """The in-program unigram draws must replay the host emitter's
+        DISTRIBUTION: empirical negative frequencies track the table's
+        composition (frequent rows drawn proportionally more)."""
+        w2v = _w2v(_sentences(rng), negative=5)
+        cache = SkipGramCorpusCache.build(w2v)
+        _, _, _, negs = skipgram_epoch_plan(
+            jax.random.PRNGKey(11), cache.tokens, cache.mask,
+            cache.keep_prob, cache.table, cache.window, cache.negative,
+            cache.n_batches, cache.batch)
+        draws = np.asarray(negs).ravel()
+        table = np.asarray(cache.table)
+        v = w2v.vocab.num_words()
+        emp = np.bincount(draws, minlength=v) / len(draws)
+        ref = np.bincount(table, minlength=v) / len(table)
+        # collision redraws perturb the marginal slightly; 3x total
+        # variation headroom still separates it cleanly from uniform
+        assert np.abs(emp - ref).sum() < 3 * np.abs(
+            ref - 1.0 / v).sum() + 0.05
+
+
+class TestFusedEquivalence:
+    def test_fused_matches_eager_replay(self, rng):
+        """E fused epochs == the same plan applied per batch eagerly
+        (same keys, same LR schedule) — tracing must not change math."""
+        sents = _sentences(rng)
+        fused = _w2v(sents, epochs=2)
+        cache = fused.build_corpus_cache()
+        hist = fused.fit_epochs(2)
+        assert hist.shape == (2, cache.n_batches)
+
+        ref = _w2v(sents, epochs=2)
+        s0, s1 = ref.syn0, ref.syn1neg
+        keys = epoch_keys_for(ref.seed, 0, 2)
+        planned = 2 * cache.n_batches
+        it = 0
+        ref_hist = np.zeros((2, cache.n_batches), np.float32)
+        for e in range(2):
+            cen, ctx, val, neg = skipgram_epoch_plan(
+                keys[e], cache.tokens, cache.mask, cache.keep_prob,
+                cache.table, cache.window, cache.negative,
+                cache.n_batches, cache.batch)
+            for n in range(cache.n_batches):
+                lr = max(ref.min_learning_rate,
+                         ref.learning_rate * (1.0 - it / planned))
+                s0, s1, loss = _neg_epoch_impl(
+                    s0, s1, cen[n], ctx[n], val[n], neg[n],
+                    jnp.asarray(lr, jnp.float32))
+                ref_hist[e, n] = float(loss)
+                it += 1
+        np.testing.assert_allclose(np.asarray(hist), ref_hist, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fused.syn0), np.asarray(s0),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fused.syn1neg),
+                                   np.asarray(s1), atol=1e-5)
+
+    def test_one_dispatch_per_chunk(self, rng):
+        w2v = _w2v(_sentences(rng), epochs=4)
+        w2v.fit_epochs(4)
+        assert w2v._train_dispatches == 1
+        chunked = _w2v(_sentences(rng), epochs=4)
+        chunked.fit_epochs(4, chunk_epochs=1)
+        assert chunked._train_dispatches == 4
+
+    def test_listeners_fire_per_chunk(self, rng):
+        calls = []
+
+        class Listener:
+            def chunk_done(self, model, it0, hist, metrics=None):
+                calls.append((it0, tuple(hist.shape)))
+
+        w2v = _w2v(_sentences(rng), epochs=3)
+        w2v.listeners.append(Listener())
+        w2v.fit_epochs(3)  # listeners present → chunk_epochs defaults to 1
+        assert w2v._train_dispatches == 3
+        assert len(calls) == 3
+
+    def test_resume_mid_run_determinism(self, rng):
+        """fit_epochs(2) twice must equal fit_epochs(4) one-shot: epoch
+        keys fold in the ABSOLUTE epoch index and the LR schedule decays
+        over the configured horizon, so chunk boundaries are invisible."""
+        sents = _sentences(rng)
+        split = _w2v(sents, epochs=4)
+        cache_s = split.build_corpus_cache()
+        h1 = split.fit_epochs(2)
+        h2 = split.fit_epochs(2)
+        oneshot = _w2v(sents, epochs=4)
+        cache_o = SkipGramCorpusCache.build(oneshot, batch=cache_s.batch)
+        h = oneshot.fit_epochs(4, cache=cache_o)
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(h1), np.asarray(h2)]),
+            np.asarray(h))
+        np.testing.assert_allclose(np.asarray(split.syn0),
+                                   np.asarray(oneshot.syn0), atol=1e-6)
+
+    def test_preemption_hook_stops_between_chunks(self, rng):
+        w2v = _w2v(_sentences(rng), epochs=4)
+        hist = w2v.fit_epochs(4, chunk_epochs=1,
+                              on_chunk=lambda done: done >= 2)
+        assert hist.shape[0] == 2
+        assert w2v._epochs_done == 2
+
+
+class TestCorpusCacheEdgeCases:
+    def test_ragged_last_bucket_and_length_one_sentences(self, rng):
+        """Ragged sentence lengths (incl. a length-1 sentence the index
+        pass drops) bucket-pad instead of crashing; pads emit no pairs."""
+        sents = ["w0 w1 w2 w3 w4 w5 w6", "w0 w1", "w2", "w3 w4 w5"]
+        w2v = _w2v(sents, window_size=2, negative=3)
+        cache = w2v.build_corpus_cache()
+        assert cache is not None
+        assert cache.tokens.shape[0] == 3  # the length-1 sentence dropped
+        hist = w2v.fit_epochs(2)
+        assert hist is not None
+        assert np.isfinite(np.asarray(w2v.syn0)).all()
+
+    def test_vocab_smaller_than_negative_count(self, rng):
+        """3-word vocab, 10 negatives per pair: draws repeat, training
+        stays finite (the reference's redraw loop tolerates this too)."""
+        sents = ["a b c a b c a", "b c a b", "c a b c a b"]
+        w2v = _w2v(sents, window_size=2, negative=10, layer_size=8)
+        assert w2v.vocab.num_words() == 3
+        hist = w2v.fit_epochs(2)
+        assert hist is not None
+        assert np.isfinite(np.asarray(hist)).all()
+        assert np.isfinite(np.asarray(w2v.syn0)).all()
+
+    def test_subsample_everything_corpus(self, rng):
+        """A sampling threshold so aggressive every token is dropped:
+        zero valid pairs, zero loss, tables untouched (masked updater)."""
+        # default corpus geometry on purpose: shares the memoized fused
+        # program with the equivalence tests (sampling only changes the
+        # keep_prob VALUES, not the compiled program)
+        w2v = _w2v(_sentences(rng), sampling=1e-12, epochs=2)
+        before0 = np.asarray(w2v.syn0).copy()
+        before1 = np.asarray(w2v.syn1neg).copy()
+        hist = w2v.fit_epochs(2)
+        assert hist is not None
+        np.testing.assert_array_equal(np.asarray(hist),
+                                      np.zeros_like(np.asarray(hist)))
+        np.testing.assert_array_equal(np.asarray(w2v.syn0), before0)
+        np.testing.assert_array_equal(np.asarray(w2v.syn1neg), before1)
+
+    def test_over_budget_falls_back_to_host(self, rng):
+        w2v = _w2v(_sentences(rng), epochs=1)
+        assert w2v.build_corpus_cache(budget_mb=0) is None
+        hist = w2v.fit_epochs(1, budget_mb=0)
+        assert hist is None  # host loop ran instead
+        assert w2v._train_dispatches == 0
+        assert np.isfinite(np.asarray(w2v.syn0)).all()
+
+    def test_fused_disabled_env_falls_back(self, rng, monkeypatch):
+        monkeypatch.setenv("DL4J_W2V_FUSED", "0")
+        w2v = _w2v(_sentences(rng), epochs=1)
+        assert w2v.fit_epochs(1) is None
+        assert w2v._train_dispatches == 0
+
+    def test_hs_and_cbow_fall_back(self, rng):
+        hs = _w2v(_sentences(rng), hierarchic_softmax=True, epochs=1)
+        assert hs.fit_epochs(1) is None
+        cbow = _w2v(_sentences(rng), algorithm="cbow", epochs=1)
+        assert cbow.fit_epochs(1) is None
+
+
+class TestEmbeddingContracts:
+    def test_single_device_program_contracts(self, rng):
+        """PR-7 checks over the cached fused program: no callbacks, NO
+        collectives at all single-device, both tables donated, outputs
+        (syn0, syn1neg, hist[E, N])."""
+        from deeplearning4j_tpu.analysis.contracts import (
+            check_embedding_contracts,
+        )
+
+        w2v = _w2v(_sentences(rng), epochs=2)
+        w2v.fit_epochs(2)
+        results = check_embedding_contracts(w2v, w2v._corpus_cache,
+                                            epochs=2)
+        assert all(not v for v in results.values())
+
+    def test_empty_program_cache_raises(self, rng):
+        from deeplearning4j_tpu.analysis.contracts import (
+            check_embedding_contracts,
+        )
+
+        w2v = _w2v(_sentences(rng))
+        cache = w2v.build_corpus_cache()
+        with pytest.raises(ValueError, match="no cached fused"):
+            check_embedding_contracts(w2v, cache)
+
+
+class TestGloveFused:
+    def test_fused_matches_host_reference(self, rng):
+        """One fused GloVe run == per-batch eager application of the
+        same masked AdaGrad step with the same in-program shuffle keys
+        (duplicate rows in a batch exercise _row_scale's joint count)."""
+        from deeplearning4j_tpu.nlp import Glove
+        from deeplearning4j_tpu.nlp.glove import _glove_step_math
+        from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+        sents = _sentences(rng, n_words=25, n_sent=80)
+        g = Glove(sentence_iterator=CollectionSentenceIterator(sents),
+                  min_word_frequency=1, layer_size=8, window_size=3,
+                  epochs=3, seed=0)
+        g.fit()
+        assert g._train_dispatches == 1
+
+        ref = Glove(sentence_iterator=CollectionSentenceIterator(sents),
+                    min_word_frequency=1, layer_size=8, window_size=3,
+                    epochs=3, seed=0)
+        ref.vocab = build_vocab(ref._sentences_tokens(), 1)
+        rows, cols, x = ref.count_cooccurrences()
+        n, d = ref.vocab.num_words(), ref.layer_size
+        k1, k2 = jax.random.split(jax.random.PRNGKey(ref.seed))
+        scale = 0.5 / d
+        tbl = (jax.random.uniform(k1, (n, d), jnp.float32, -scale, scale),
+               jax.random.uniform(k2, (n, d), jnp.float32, -scale, scale),
+               jnp.zeros((n,)), jnp.zeros((n,)),
+               jnp.full((n, d), 1e-8), jnp.full((n, d), 1e-8),
+               jnp.full((n,), 1e-8), jnp.full((n,), 1e-8))
+        logx = np.log(np.maximum(x, 1e-12)).astype(np.float32)
+        fx = np.minimum(1.0, (x / ref.x_max) ** ref.alpha).astype(
+            np.float32)
+        batch = min(ref.batch_size, max(32, len(rows) // 8))
+        total = -(-len(rows) // batch) * batch
+        pad = total - len(rows)
+        rows = np.pad(rows.astype(np.int32), (0, pad))
+        cols = np.pad(cols.astype(np.int32), (0, pad))
+        logx, fx = np.pad(logx, (0, pad)), np.pad(fx, (0, pad))
+        base = jax.random.PRNGKey(ref.seed)
+        epoch_keys = jax.vmap(lambda e: jax.random.fold_in(base, e))(
+            jnp.arange(ref.epochs))
+        for e in range(ref.epochs):
+            order = np.asarray(jax.random.permutation(epoch_keys[e],
+                                                      total))
+            for s in range(0, total, batch):
+                sel = order[s:s + batch]
+                *tbl, _ = _glove_step_math(
+                    *tbl, jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
+                    jnp.asarray(logx[sel]), jnp.asarray(fx[sel]),
+                    jnp.asarray(ref.learning_rate, jnp.float32))
+                tbl = tuple(tbl)
+        host_syn0 = np.asarray(tbl[0]) + np.asarray(tbl[1])
+        np.testing.assert_allclose(g.syn0, host_syn0, atol=1e-5)
+
+    def test_padded_triples_are_inert(self, rng):
+        """fx=0 pad triples: zero gradient, zero accumulator growth, and
+        excluded from the loss mean."""
+        from deeplearning4j_tpu.nlp.glove import _glove_step_math
+
+        n, d, b = 6, 4, 8
+        key = jax.random.PRNGKey(0)
+        w = jax.random.normal(key, (n, d)) * 0.1
+        tbl = (w, w + 0.01, jnp.zeros((n,)), jnp.zeros((n,)),
+               jnp.full((n, d), 1e-8), jnp.full((n, d), 1e-8),
+               jnp.full((n,), 1e-8), jnp.full((n,), 1e-8))
+        rows = jnp.asarray([0, 1, 2, 0, 0, 0, 0, 0], jnp.int32)
+        cols = jnp.asarray([1, 2, 3, 0, 0, 0, 0, 0], jnp.int32)
+        logx = jnp.asarray([0.5, 0.2, 0.1, 0, 0, 0, 0, 0], jnp.float32)
+        fx = jnp.asarray([1, 1, 1, 0, 0, 0, 0, 0], jnp.float32)
+        lr = jnp.asarray(0.05, jnp.float32)
+        *out_pad, loss_pad = _glove_step_math(*tbl, rows, cols, logx, fx,
+                                              lr)
+        *out_ref, loss_ref = _glove_step_math(
+            *tbl, rows[:3], cols[:3], logx[:3], fx[:3], lr)
+        np.testing.assert_allclose(float(loss_pad), float(loss_ref),
+                                   atol=1e-6)
+        for a, b_ in zip(out_pad, out_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=1e-6)
